@@ -1,0 +1,106 @@
+"""Reduction-op constants and combine rules.
+
+Mirrors the reference's library-stable op-code enum ``Mpi4torchCollectiveOps``
+(reference: csrc/extension.cpp:204-252) and its torch→MPI dtype mapping
+(csrc/extension.cpp:106-129).  The reference supports only
+Byte/Char/Short/Int/Long/Float/Double; this framework is a superset: every
+dtype JAX supports (including bfloat16/float16, bool, complex) is accepted,
+because on TPU bfloat16 is the native matmul/collective dtype.
+
+Op-code values are identical to the reference enum so that serialized
+descriptors are interchangeable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Library-stable integer codes (reference: csrc/extension.cpp:204-217).
+MPI_MAX = 1
+MPI_MIN = 2
+MPI_SUM = 3
+MPI_PROD = 4
+MPI_LAND = 5
+MPI_BAND = 6
+MPI_LOR = 7
+MPI_BOR = 8
+MPI_LXOR = 9
+MPI_BXOR = 10
+MPI_MINLOC = 11
+MPI_MAXLOC = 12
+
+_OP_NAMES = {
+    MPI_MAX: "MPI_MAX",
+    MPI_MIN: "MPI_MIN",
+    MPI_SUM: "MPI_SUM",
+    MPI_PROD: "MPI_PROD",
+    MPI_LAND: "MPI_LAND",
+    MPI_BAND: "MPI_BAND",
+    MPI_LOR: "MPI_LOR",
+    MPI_BOR: "MPI_BOR",
+    MPI_LXOR: "MPI_LXOR",
+    MPI_BXOR: "MPI_BXOR",
+    MPI_MINLOC: "MPI_MINLOC",
+    MPI_MAXLOC: "MPI_MAXLOC",
+}
+
+
+def op_name(op: int) -> str:
+    return _OP_NAMES.get(op, f"<unknown op {op}>")
+
+
+def combine2(op: int, a, b):
+    """Elementwise combination of two operands for reduction op ``op``.
+
+    Used by the eager (thread-SPMD) backend to reduce deterministically in
+    ascending rank order — the analogue of MPI's commutative-op reduction but
+    with a *fixed* evaluation order, which is what makes gradients bit-exact
+    and run-to-run reproducible (BASELINE.md north-star requirement).
+
+    MPI_MINLOC/MPI_MAXLOC operate on (value, index) pairs in MPI; the
+    reference forwards them to MPI with a scalar datatype, which MPI rejects
+    at runtime (csrc/extension.cpp:106-129 has no pair types).  We reject
+    them here with a clear error instead.
+    """
+    if op == MPI_SUM:
+        return a + b
+    if op == MPI_MAX:
+        return jnp.maximum(a, b)
+    if op == MPI_MIN:
+        return jnp.minimum(a, b)
+    if op == MPI_PROD:
+        return a * b
+    if op == MPI_LAND:
+        return jnp.logical_and(a != 0, b != 0).astype(a.dtype)
+    if op == MPI_BAND:
+        return a & b
+    if op == MPI_LOR:
+        return jnp.logical_or(a != 0, b != 0).astype(a.dtype)
+    if op == MPI_BOR:
+        return a | b
+    if op == MPI_LXOR:
+        return jnp.logical_xor(a != 0, b != 0).astype(a.dtype)
+    if op == MPI_BXOR:
+        return a ^ b
+    if op in (MPI_MINLOC, MPI_MAXLOC):
+        raise NotImplementedError(
+            f"{op_name(op)} requires (value, index) pair semantics; the MPI "
+            "reference forwards plain tensors to MPI which rejects them at "
+            "runtime (no pair datatype in csrc/extension.cpp:106-129). "
+            "Use Allreduce(MPI_MIN/MPI_MAX) plus an argmin/argmax instead."
+        )
+    raise ValueError(f"Unknown reduction op code {op}")
+
+
+def reduce_ordered(op: int, values):
+    """Reduce a list of per-rank tensors in ascending rank order.
+
+    Fixed linear order => deterministic, reproducible floating-point results
+    (the 'MPI reference oracle' for the bit-exactness target in BASELINE.md).
+    """
+    if not values:
+        raise ValueError("reduce_ordered needs at least one value")
+    out = values[0]
+    for v in values[1:]:
+        out = combine2(op, out, v)
+    return out

@@ -1,0 +1,9 @@
+"""Differentiable communication ops.
+
+Two backends implement the same op table (SURVEY.md §2.2):
+
+* :mod:`mpi4torch_tpu.ops.eager` — thread-SPMD eager execution with concrete
+  per-rank shapes/ranks (the ``mpirun`` parity harness, Mode B).
+* :mod:`mpi4torch_tpu.ops.spmd` — single-trace SPMD over a named mesh axis,
+  lowering to XLA collectives over ICI/DCN (the TPU performance path, Mode A).
+"""

@@ -1,0 +1,25 @@
+"""Mode A: SPMD-traced collectives over a named mesh axis (TPU fast path).
+
+Placeholder module — filled in by the SPMD milestone.  The facade
+(:mod:`mpi4torch_tpu.comm`) queries :func:`current_spmd_context` to decide
+whether a traced mesh context is active.
+"""
+
+from __future__ import annotations
+
+
+def current_spmd_context():
+    return None
+
+
+class SpmdBackend:
+    def __init__(self, ctx):
+        raise NotImplementedError("SPMD backend lands in the next milestone")
+
+
+def comm_from_mesh(mesh, axis_name: str):
+    raise NotImplementedError("SPMD backend lands in the next milestone")
+
+
+def join_dummies(loopthrough, dummies):
+    raise NotImplementedError("SPMD backend lands in the next milestone")

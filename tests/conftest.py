@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+The reference CI runs every test under ``mpirun -np {2,5,7}`` with
+oversubscribed processes on one host (reference:
+.github/workflows/test.yml:62-84).  The analogue here: a CPU platform with 8
+virtual XLA devices (for the SPMD mesh backend) and the thread-SPMD eager
+runtime (for per-rank tests) — see SURVEY.md §4 'What the rebuild needs'.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+# The reference test suite is float64 throughout (torch.double).
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# The env var alone does not stop an externally-registered TPU plugin from
+# being initialized (and possibly hanging on an unavailable accelerator);
+# the explicit config update does.  Then warm the backend up on the main
+# thread so rank-threads never race backend initialization.
+jax.config.update("jax_platforms", "cpu")
+jax.devices()

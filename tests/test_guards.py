@@ -1,0 +1,109 @@
+"""Misuse-detector tests — the framework's domain-specific 'race detectors'
+(SURVEY.md §5 'Race detection'): wait-handle bifurcation and exactly-once
+completion (reference guards csrc/extension.cpp:1196-1202, 1231-1237),
+in-place reuse (csrc/extension.cpp:395-403), plus the detectors this
+framework adds beyond the reference: collective-mismatch detection and
+deadlock timeouts (MPI would hang or corrupt; we raise)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import COMM_WORLD as comm, run_ranks
+
+
+def test_double_wait_raises():
+    def body():
+        a = jnp.asarray([1.0 + comm.rank])
+        h = comm.Isend(a, (comm.rank + 1) % comm.size, 0)
+        comm.Recv(jnp.empty_like(a), (comm.rank - 1 + comm.size) % comm.size, 0)
+        comm.Wait(h)
+        with pytest.raises(mpi.BifurcationError, match="bifurcation"):
+            comm.Wait(h)
+
+    run_ranks(body, 2)
+
+
+def test_swapped_handle_parts_raise():
+    # Splicing the descriptor of one request onto the buffer of another is
+    # the 'bifurcation' hazard the reference hash-guards against
+    # (csrc/extension.cpp:1231-1237).
+    def body():
+        a = jnp.ones(3) * comm.rank
+        b = jnp.ones(5) * comm.rank
+        h1 = comm.Isend(a, (comm.rank + 1) % comm.size, 0)
+        h2 = comm.Isend(b, (comm.rank + 1) % comm.size, 1)
+        comm.Recv(jnp.empty(3), (comm.rank - 1 + comm.size) % comm.size, 0)
+        comm.Recv(jnp.empty(5), (comm.rank - 1 + comm.size) % comm.size, 1)
+        frankenstein = mpi.WaitHandle(
+            [h1._handle[0], h2._handle[1], h2._handle[2]])
+        with pytest.raises(mpi.BifurcationError):
+            comm.Wait(frankenstein)
+        comm.Wait(h2)
+
+    run_ranks(body, 2)
+
+
+def test_collective_mismatch_detected():
+    # MPI deadlocks or corrupts buffers when ranks disagree on the
+    # collective; this runtime raises on every rank.
+    def body():
+        x = jnp.ones(4)
+        with pytest.raises(mpi.CollectiveMismatchError):
+            if comm.rank == 0:
+                comm.Allreduce(x, mpi.MPI_SUM)
+            else:
+                comm.Bcast_(x, 0)
+
+    run_ranks(body, 2)
+
+
+def test_shape_mismatch_detected():
+    # Allreduce requires identical shapes on all ranks; MPI would read out
+    # of bounds.
+    def body():
+        x = jnp.ones(4 + comm.rank)
+        with pytest.raises(mpi.CollectiveMismatchError):
+            comm.Allreduce(x, mpi.MPI_SUM)
+
+    run_ranks(body, 2)
+
+
+def test_recv_deadlock_times_out():
+    def body():
+        if comm.rank == 0:
+            with pytest.raises(mpi.DeadlockError, match="timed out"):
+                comm.Recv(jnp.empty(3), 1, 99)
+        # rank 1 never sends
+
+    run_ranks(body, 2, timeout=1.0)
+
+
+def test_missing_collective_times_out():
+    def body():
+        if comm.rank == 0:
+            with pytest.raises((mpi.DeadlockError, mpi.CommError)):
+                comm.Allreduce(jnp.ones(3), mpi.MPI_SUM)
+        # rank 1 never joins the collective
+
+    run_ranks(body, 2, timeout=1.0)
+
+
+def test_invalid_root_raises():
+    def body():
+        with pytest.raises(mpi.CommError, match="root"):
+            comm.Bcast_(jnp.ones(3), 7)
+
+    run_ranks(body, 2)
+
+
+def test_minloc_rejected_with_explanation():
+    # reference forwards MPI_MINLOC to MPI with a scalar dtype, which MPI
+    # rejects at runtime (no pair datatype, csrc/extension.cpp:106-129); we
+    # reject with a clear error up front.
+    def body():
+        with pytest.raises(NotImplementedError, match="MINLOC"):
+            comm.Allreduce(jnp.ones(3), mpi.MPI_MINLOC)
+
+    run_ranks(body, 2)
