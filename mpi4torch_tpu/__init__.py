@@ -49,6 +49,8 @@ from .runtime import (
     InPlaceReuseError,
     run_ranks,
 )
+from .ops.spmd import RankExpr, run_spmd
+from . import config
 
 __all__ = [
     # reference __all__ (src/__init__.py:5-25)
@@ -74,6 +76,9 @@ __all__ = [
     # TPU-native additions
     "comm_from_mesh",
     "run_ranks",
+    "run_spmd",
+    "RankExpr",
+    "config",
     "CommError",
     "CollectiveMismatchError",
     "DeadlockError",
