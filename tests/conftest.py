@@ -20,9 +20,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
-# The env var alone does not stop an externally-registered TPU plugin from
-# being initialized (and possibly hanging on an unavailable accelerator);
-# the explicit config update does.  Then warm the backend up on the main
+# The env vars alone are not enough when something (e.g. an accelerator
+# plugin's sitecustomize) imported jax before this conftest ran: the
+# explicit config updates work post-import.  jax_platforms=cpu also stops
+# an externally-registered TPU plugin from initializing (and possibly
+# hanging on an unavailable tunnel).  Then warm the backend up on the main
 # thread so rank-threads never race backend initialization.
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 jax.devices()

@@ -1,0 +1,51 @@
+"""Example programs as integration tests (reference: examples/ — the two
+scripts are parity configs #1 and #3 in BASELINE.md)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, EXAMPLES / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("nranks", [2, 5])
+def test_simple_linear_regression(nranks):
+    mod = _load("simple_linear_regression")
+    results = mpi.run_ranks(mod.main, nranks)
+    params0, loss0 = results[0]
+    for p, _ in results:
+        np.testing.assert_array_equal(params0, p)
+    np.testing.assert_allclose(params0, [0.1, 1.0, -2.0], atol=1e-5)
+
+
+def test_regression_rank_count_invariance():
+    # The documented property (reference doc/examples.rst:46-65): the
+    # parameter-averaging Allreduce makes the optimization trajectory
+    # independent of the number of ranks.
+    mod = _load("simple_linear_regression")
+    p2 = mpi.run_ranks(mod.main, 2)[0][0]
+    p5 = mpi.run_ranks(mod.main, 5)[0][0]
+    np.testing.assert_allclose(p2, p5, rtol=1e-8)
+
+
+@pytest.mark.parametrize("nranks", [2, 5])
+def test_isend_recv_wait(nranks):
+    mod = _load("isend_recv_wait")
+    results = mpi.run_ranks(mod.main, nranks)
+    for r, (res, grad) in enumerate(results):
+        left = (r - 1 + nranks) % nranks
+        assert res[0] == (1.0 + r) + (1.0 + left)
+        assert grad[0] == 2.0
