@@ -92,14 +92,41 @@ def combine2(op: int, a, b):
     raise ValueError(f"Unknown reduction op code {op}")
 
 
+# Below this element count the N-1 jnp folds beat the host round-trip of
+# the native kernel.
+_NATIVE_REDUCE_MIN_SIZE = 32768
+
+
+def _on_cpu(v) -> bool:
+    try:
+        return all(d.platform == "cpu" for d in v.devices())
+    except AttributeError:
+        return True  # plain numpy
+
+
 def reduce_ordered(op: int, values):
     """Reduce a list of per-rank tensors in ascending rank order.
 
     Fixed linear order => deterministic, reproducible floating-point results
     (the 'MPI reference oracle' for the bit-exactness target in BASELINE.md).
+    Large CPU-resident operands take the fused native kernel
+    (mpi4torch_tpu/_native), which folds in the identical order in one
+    memory pass; the pure-JAX fold is the always-available fallback and is
+    bit-equal.
     """
     if not values:
         raise ValueError("reduce_ordered needs at least one value")
+    if len(values) > 1:
+        first = values[0]
+        if (getattr(first, "size", 0) >= _NATIVE_REDUCE_MIN_SIZE
+                and all(_on_cpu(v) for v in values)):
+            from . import _native
+            if _native.available():
+                import numpy as np
+                res = _native.ordered_reduce(
+                    [np.asarray(v) for v in values], op)
+                if res is not None:
+                    return jnp.asarray(res)
     out = values[0]
     for v in values[1:]:
         out = combine2(op, out, v)

@@ -82,7 +82,10 @@ class _PendingRequest:
 def _fnv1a(parts) -> int:
     """FNV-1a hash over a string description — the analogue of the 32-bit
     data-pointer hash the reference smuggles into the request descriptor
-    (csrc/extension.cpp:1100, re-checked at 1231-1237)."""
+    (csrc/extension.cpp:1100, re-checked at 1231-1237).  Kept pure-Python:
+    the inputs are tiny and this sits on the request-creation hot path, so
+    it must never wait on the native library's first build (the identical
+    native fnv1a32 exists for bulk hashing and is tested bit-equal)."""
     h = 0x811C9DC5
     for ch in "|".join(str(p) for p in parts).encode():
         h ^= ch

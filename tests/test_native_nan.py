@@ -1,0 +1,28 @@
+"""Regression: native MAX/MIN must propagate NaN exactly like the pure-JAX
+fold (review finding: `a > b ? a : b` drops NaNs)."""
+
+import numpy as np
+import pytest
+
+from mpi4torch_tpu import constants
+from mpi4torch_tpu import _native
+
+
+@pytest.mark.parametrize("op", [constants.MPI_MAX, constants.MPI_MIN])
+def test_nan_propagation_matches_fold(op):
+    if not _native.available():
+        pytest.skip("no native library")
+    a = np.asarray([np.nan, -0.0, 2.0], dtype=np.float64)
+    b = np.asarray([1.0, -0.0, np.nan], dtype=np.float64)
+    native = _native.ordered_reduce([a, b], op)
+    import jax.numpy as jnp
+    fold = np.asarray(constants.combine2(op, jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(native, fold)
+
+
+def test_mixed_dtype_rejected():
+    if not _native.available():
+        pytest.skip("no native library")
+    out = _native.ordered_reduce(
+        [np.ones(4, np.float64), np.ones(4, np.float32)], constants.MPI_SUM)
+    assert out is None
