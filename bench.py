@@ -1,0 +1,86 @@
+"""Headline benchmark: Allreduce forward+backward effective bandwidth.
+
+Measures the BASELINE.md primary metric — fwd+bwd Allreduce GB/s per chip —
+on whatever devices are available: the full local device set as the mesh
+(N real TPU chips, or the single tunneled chip).  The whole measured region
+(forward psum, adjoint psum, elementwise loss) is ONE jitted XLA program.
+
+Bytes-on-wire per chip per collective uses the standard ring-allreduce
+accounting 2*(N-1)/N * size; on a single chip there is no interconnect, so
+the reported number is the HBM-limited pipeline throughput of the same
+program (bytes = tensor size per pass), honestly labeled in the JSON.
+
+Baseline: the reference publishes no numbers (BASELINE.md); the working
+target is 80% of ~45 GB/s/link v5e ICI ≈ 36 GB/s/chip, so
+``vs_baseline = value / 36.0``.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4torch_tpu as mpi
+
+    devs = jax.devices()
+    n = len(devs)
+    platform = devs[0].platform
+
+    # 256 MiB/chip on TPU (1B params would OOM nothing but adds no signal
+    # beyond saturation); small on the CPU smoke path.
+    nelem = (1 << 26) if platform == "tpu" else (1 << 18)
+    dtype = jnp.float32
+    bytes_per_pass = nelem * 4
+
+    comm = mpi.COMM_WORLD
+
+    def loss(x):
+        y = comm.Allreduce(x, mpi.MPI_SUM)
+        return jnp.vdot(y, y)
+
+    step = mpi.run_spmd(lambda x: jax.value_and_grad(loss)(x), nranks=n)
+
+    x = jnp.ones((nelem,), dtype)
+    # Warmup: compile + first run.
+    out = step(x)
+    jax.block_until_ready(out)
+
+    iters = 20 if platform == "tpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    if n > 1:
+        wire_per_collective = 2.0 * (n - 1) / n * bytes_per_pass
+    else:
+        wire_per_collective = float(bytes_per_pass)
+    # fwd Allreduce + adjoint Allreduce per step.
+    gbps = 2.0 * wire_per_collective / dt / 1e9
+
+    target_gbps = 36.0  # 0.8 * ~45 GB/s v5e ICI per-link (BASELINE.md)
+    print(json.dumps({
+        "metric": "allreduce_fwd_bwd_bandwidth_per_chip",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / target_gbps, 4),
+        "n_devices": n,
+        "platform": platform,
+        "tensor_mib": bytes_per_pass / (1 << 20),
+        "seconds_per_step": dt,
+        "note": ("ring-allreduce bytes-on-wire accounting" if n > 1 else
+                 "single chip: HBM-limited pipeline throughput, no ICI"),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import constants as _C
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libmpi4torch_tpu_native.so")
 
@@ -80,8 +82,9 @@ _REDUCE_FNS = {
 # Ops the arithmetic kernels support for float dtypes (bitwise/logical ops
 # are integer-only in the native layer, like the reference's MPI dtype
 # table restricts op/dtype combinations, csrc/extension.cpp:106-129).
-_FLOAT_OPS = {1, 2, 3, 4}          # MAX, MIN, SUM, PROD
-_INT_OPS = {1, 2, 3, 4, 5, 6, 7, 8, 9, 10}
+_FLOAT_OPS = {_C.MPI_MAX, _C.MPI_MIN, _C.MPI_SUM, _C.MPI_PROD}
+_INT_OPS = _FLOAT_OPS | {_C.MPI_LAND, _C.MPI_BAND, _C.MPI_LOR, _C.MPI_BOR,
+                         _C.MPI_LXOR, _C.MPI_BXOR}
 
 
 def ordered_reduce(arrays: List[np.ndarray], op: int) -> Optional[np.ndarray]:

@@ -19,6 +19,7 @@
 // ctypes; every entry point has a pure-Python fallback, so the framework
 // works without a toolchain.
 
+#include <cmath>
 #include <cstdint>
 #include <cstddef>
 
@@ -58,10 +59,19 @@ inline T combine_arith(int32_t op, T a, T b) {
   switch (op) {
     case OP_SUM:  return a + b;
     case OP_PROD: return a * b;
-    // MAX/MIN propagate NaN from either operand, matching jnp.maximum/
-    // minimum, so the native path stays bit-equal to the pure-JAX fold.
-    case OP_MAX:  return a != a ? a : (b != b ? b : (a > b ? a : b));
-    case OP_MIN:  return a != a ? a : (b != b ? b : (a < b ? a : b));
+    // MAX/MIN propagate NaN from either operand and resolve signed-zero
+    // ties toward +0.0 (MAX) / -0.0 (MIN), matching jnp.maximum/minimum,
+    // so the native path stays bit-equal to the pure-JAX fold.
+    case OP_MAX:
+      if (a != a) return a;
+      if (b != b) return b;
+      if (a == b) return std::signbit(a) ? b : a;
+      return a > b ? a : b;
+    case OP_MIN:
+      if (a != a) return a;
+      if (b != b) return b;
+      if (a == b) return std::signbit(a) ? a : b;
+      return a < b ? a : b;
     default:      return a;  // validated on the Python side
   }
 }

@@ -126,7 +126,13 @@ def reduce_ordered(op: int, values):
                 res = _native.ordered_reduce(
                     [np.asarray(v) for v in values], op)
                 if res is not None:
-                    return jnp.asarray(res)
+                    # JAX inputs already carry canonical dtypes, so the
+                    # round-trip is lossless; plain-numpy inputs keep their
+                    # numpy dtype exactly like the fallback fold would
+                    # (jnp.asarray would downcast f64/i64 with x64 off).
+                    if any(hasattr(v, "devices") for v in values):
+                        return jnp.asarray(res)
+                    return res
     out = values[0]
     for v in values[1:]:
         out = combine2(op, out, v)

@@ -89,3 +89,35 @@ def test_large_allreduce_uses_native_path_and_matches_oracle():
         oracle = oracle + data[r]
     for r in range(4):
         np.testing.assert_array_equal(out[r], oracle)
+
+
+@pytest.mark.parametrize("op", [constants.MPI_MAX, constants.MPI_MIN])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_signed_zero_ties_match_jnp_fold(op, dtype):
+    # MAX(+0,-0) must be +0 and MIN(+0,-0) must be -0 in every operand
+    # order, bit-identical to the jnp.maximum/minimum fold.
+    if not _native.available():
+        pytest.skip("no native library")
+    pz, nz = dtype(0.0), dtype(-0.0)
+    for pattern in [(pz, nz), (nz, pz), (nz, nz), (pz, pz)]:
+        arrays = [np.full(64, v, dtype) for v in pattern]
+        native = _native.ordered_reduce(arrays, op)
+        fold = jnp.asarray(arrays[0])
+        for a in arrays[1:]:
+            fold = constants.combine2(op, fold, jnp.asarray(a))
+        fold = np.asarray(fold)
+        np.testing.assert_array_equal(
+            np.signbit(native), np.signbit(fold),
+            err_msg=f"op={op} pattern={pattern}")
+        np.testing.assert_array_equal(native, fold)
+
+
+def test_reduce_ordered_preserves_numpy_dtype_above_native_threshold():
+    # Above the native-dispatch threshold, float64/int64 numpy operands must
+    # come back in their own dtype (no jnp canonicalization downcast).
+    n = constants._NATIVE_REDUCE_MIN_SIZE + 1
+    for dtype in (np.float64, np.int64):
+        arrays = [np.ones(n, dtype) for _ in range(3)]
+        out = constants.reduce_ordered(constants.MPI_SUM, arrays)
+        assert np.asarray(out).dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(np.asarray(out), np.full(n, 3, dtype))
