@@ -25,18 +25,22 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def _build() -> bool:
+    # Always invoked: make's `$(SO): native.cc` dependency makes this a
+    # no-op when fresh and a rebuild when native.cc/Makefile changed —
+    # otherwise a stale prebuilt .so would silently keep running old
+    # kernels after a source fix.
     try:
         subprocess.run(["make", "-C", _HERE], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_SO)
     except (OSError, subprocess.SubprocessError):
-        return False
+        return os.path.exists(_SO)  # no toolchain: use an existing build
 
 
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("MPI4TORCH_TPU_NO_NATIVE") == "1":
         return None
-    if not os.path.exists(_SO) and not _build():
+    if not _build():
         return None
     try:
         lib = ctypes.CDLL(_SO)

@@ -61,25 +61,32 @@ def combine2(op: int, a, b):
     reference forwards them to MPI with a scalar datatype, which MPI rejects
     at runtime (csrc/extension.cpp:106-129 has no pair types).  We reject
     them here with a clear error instead.
+
+    Plain-numpy operands combine in numpy so their dtype is preserved
+    exactly (jnp would canonicalize f64->f32 with x64 off), keeping the
+    fallback fold bit-equal to the native kernel for every op.
     """
+    import numpy as _np
+    xp = _np if (isinstance(a, _np.ndarray) and isinstance(b, _np.ndarray)) \
+        else jnp
     if op == MPI_SUM:
         return a + b
     if op == MPI_MAX:
-        return jnp.maximum(a, b)
+        return xp.maximum(a, b)
     if op == MPI_MIN:
-        return jnp.minimum(a, b)
+        return xp.minimum(a, b)
     if op == MPI_PROD:
         return a * b
     if op == MPI_LAND:
-        return jnp.logical_and(a != 0, b != 0).astype(a.dtype)
+        return xp.logical_and(a != 0, b != 0).astype(a.dtype)
     if op == MPI_BAND:
         return a & b
     if op == MPI_LOR:
-        return jnp.logical_or(a != 0, b != 0).astype(a.dtype)
+        return xp.logical_or(a != 0, b != 0).astype(a.dtype)
     if op == MPI_BOR:
         return a | b
     if op == MPI_LXOR:
-        return jnp.logical_xor(a != 0, b != 0).astype(a.dtype)
+        return xp.logical_xor(a != 0, b != 0).astype(a.dtype)
     if op == MPI_BXOR:
         return a ^ b
     if op in (MPI_MINLOC, MPI_MAXLOC):

@@ -121,3 +121,21 @@ def test_reduce_ordered_preserves_numpy_dtype_above_native_threshold():
         out = constants.reduce_ordered(constants.MPI_SUM, arrays)
         assert np.asarray(out).dtype == np.dtype(dtype)
         np.testing.assert_array_equal(np.asarray(out), np.full(n, 3, dtype))
+
+
+def test_native_and_fallback_agree_on_dtype_for_all_ops():
+    # Native-present and native-absent runs must return identical dtype AND
+    # bits for numpy operands regardless of jnp canonicalization settings.
+    n = constants._NATIVE_REDUCE_MIN_SIZE + 1
+    rng = np.random.default_rng(5)
+    arrays64 = [rng.standard_normal(n) for _ in range(3)]
+    for op in (constants.MPI_MAX, constants.MPI_MIN, constants.MPI_SUM,
+               constants.MPI_PROD):
+        via_native = constants.reduce_ordered(op, arrays64)
+        fold = arrays64[0]
+        for a in arrays64[1:]:
+            fold = constants.combine2(op, fold, a)
+        assert np.asarray(via_native).dtype == np.float64
+        assert np.asarray(fold).dtype == np.float64
+        np.testing.assert_array_equal(np.asarray(via_native),
+                                      np.asarray(fold))
