@@ -24,17 +24,29 @@ _SO = os.path.join(_HERE, "libmpi4torch_tpu_native.so")
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(
+        os.path.getmtime(os.path.join(_HERE, src)) > so_mtime
+        for src in ("native.cc", "Makefile"))
+
+
 def _build() -> bool:
-    # Always invoked: make's `$(SO): native.cc` dependency makes this a
-    # no-op when fresh and a rebuild when native.cc/Makefile changed —
-    # otherwise a stale prebuilt .so would silently keep running old
-    # kernels after a source fix.
+    # Rebuild only when native.cc/Makefile are newer than the .so (a stale
+    # prebuilt binary must not keep running old kernels after a source fix,
+    # and a fresh one must not pay a make subprocess on every import).
+    if not _stale():
+        return True
     try:
         subprocess.run(["make", "-C", _HERE], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_SO)
-    except (OSError, subprocess.SubprocessError):
+    except OSError:
         return os.path.exists(_SO)  # no toolchain: use an existing build
+    except subprocess.SubprocessError:
+        return False  # build FAILED: never load a stale binary silently
 
 
 def _load() -> Optional[ctypes.CDLL]:

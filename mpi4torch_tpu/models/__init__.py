@@ -9,6 +9,6 @@ executable documentation of each parallelism strategy (SURVEY.md §2.5) and
 as the flagship programs for the benchmark/graft entry points.
 """
 
-from . import mlp
+from . import mlp, transformer
 
-__all__ = ["mlp"]
+__all__ = ["mlp", "transformer"]

@@ -19,8 +19,6 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..constants import MPI_SUM
-
 
 def init_params(key, sizes: Sequence[int], dtype=jnp.float32) -> List:
     """Glorot-ish init for an MLP with layer widths ``sizes``."""
@@ -49,19 +47,11 @@ def local_loss(params, batch):
 
 
 def dp_loss(comm, params, batch):
-    """Global data-parallel loss: the reference's two-Allreduce recipe
-    (reference: examples/simple_linear_regression.py:27-35).
-
-    The parameter-averaging Allreduce looks redundant (params are already
-    replicated) but is load-bearing: its adjoint sums the per-rank loss
-    gradients and divides by size, so every rank's parameter gradient is
-    the MEAN of all local gradients and per-rank optimizer instances stay
-    arithmetically identical (reference: doc/examples.rst:46-65).  Without
-    it, each rank's adjoint path stops at its own local gradient and the
-    replicas diverge."""
-    params = jax.tree.map(
-        lambda p: comm.Allreduce(p, MPI_SUM) / comm.size, params)
-    return comm.Allreduce(local_loss(params, batch), MPI_SUM) / comm.size
+    """Global data-parallel loss via :func:`mpi4torch_tpu.parallel.dp.dp_loss`
+    (the reference's two-Allreduce recipe; the parameter-averaging Allreduce
+    is load-bearing — see parallel/dp.py)."""
+    from ..parallel import dp as _dp
+    return _dp.dp_loss(comm, local_loss, params, batch)
 
 
 def dp_train_step(comm, params, batch, lr: float = 1e-2) -> Tuple:
@@ -69,6 +59,7 @@ def dp_train_step(comm, params, batch, lr: float = 1e-2) -> Tuple:
 
     Jittable under both backends; under ``run_spmd`` the whole step —
     forward, adjoint collective, update — compiles to one XLA program."""
-    loss, grads = jax.value_and_grad(lambda p: dp_loss(comm, p, batch))(params)
+    from ..parallel import dp as _dp
+    loss, grads = _dp.dp_value_and_grad(comm, local_loss)(params, batch)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return loss, new_params

@@ -1,0 +1,195 @@
+"""Flagship model: decoder-only transformer, distributed 2D (dp x sp).
+
+The capstone composition of the framework's strategy layer (SURVEY.md
+§2.5): data parallelism over one mesh axis via the reference's two-Allreduce
+recipe, and long-context sequence/context parallelism over a second axis —
+the sequence dimension is sharded across ranks and attention runs as ring
+attention (blockwise, K/V circulating over the differentiable
+Isend/Irecv ring) or Ulysses (head<->sequence Alltoall).  Every distributed
+movement is an ``MPI_Communicator`` op, so the same model runs on the eager
+thread-SPMD runtime, inside ``run_spmd``, or in a user-managed 2D
+``shard_map`` via ``comm_from_mesh`` (the intended TPU deployment).
+
+TPU-first shapes: all compute is batched matmul/einsum (MXU), parameters
+and activations stay in the caller's dtype (bfloat16-ready), and the
+sequence axis per rank is static so XLA tiles cleanly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import MPI_SUM
+from ..parallel.attention import dense_attention, ring_attention, \
+    ulysses_attention
+from ..parallel.dp import all_average_tree
+from ..parallel.ring import ring_shift
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Static model hyperparameters (kept OUT of the parameter pytree so
+    grads/optimizer tree-maps see arrays only)."""
+    vocab: int
+    d_model: int
+    n_heads: int
+    n_layers: int
+    d_ff: int
+    max_seq: int
+
+
+def init_transformer(key, cfg: TransformerConfig,
+                     dtype=jnp.float32) -> Dict[str, Any]:
+    """Parameter pytree for a pre-LN decoder-only transformer."""
+    vocab, d_model, d_ff = cfg.vocab, cfg.d_model, cfg.d_ff
+    n_layers, max_seq = cfg.n_layers, cfg.max_seq
+    def dense(key, m, n):
+        return jax.random.normal(key, (m, n), dtype) / jnp.sqrt(
+            jnp.asarray(m, dtype))
+
+    keys = iter(jax.random.split(key, 4 + 6 * n_layers))
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(next(keys), (vocab, d_model), dtype) * 0.02,
+        "pos": jax.random.normal(next(keys), (max_seq, d_model), dtype) * 0.02,
+        "ln_f": {"scale": jnp.ones((d_model,), dtype),
+                 "bias": jnp.zeros((d_model,), dtype)},
+        "unembed": dense(next(keys), d_model, vocab),
+        "blocks": [],
+    }
+    for _ in range(n_layers):
+        params["blocks"].append({
+            "ln1": {"scale": jnp.ones((d_model,), dtype),
+                    "bias": jnp.zeros((d_model,), dtype)},
+            "wqkv": dense(next(keys), d_model, 3 * d_model),
+            "wo": dense(next(keys), d_model, d_model),
+            "ln2": {"scale": jnp.ones((d_model,), dtype),
+                    "bias": jnp.zeros((d_model,), dtype)},
+            "w1": dense(next(keys), d_model, d_ff),
+            "w2": dense(next(keys), d_ff, d_model),
+        })
+    return params
+
+
+def _layer_norm(x, p):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * p["scale"] + p["bias"]
+
+
+def _attention(q, k, v, comm_sp, attn: str):
+    if comm_sp is None or comm_sp.size == 1:
+        return dense_attention(q, k, v, causal=True)
+    if attn == "dense":
+        raise ValueError(
+            "attn='dense' cannot see across sequence shards: with a "
+            "size>1 sequence-parallel communicator each rank would attend "
+            "only within its own block (and mask as if it started at "
+            "position 0).  Use attn='ring' or attn='ulysses', or pass "
+            "comm_sp=None with the full sequence."
+        )
+    if attn == "ring":
+        return ring_attention(comm_sp, q, k, v, causal=True)
+    if attn == "ulysses":
+        return ulysses_attention(comm_sp, q, k, v, causal=True)
+    raise ValueError(f"unknown attention strategy {attn!r}")
+
+
+def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
+            attn: str = "ring"):
+    """Logits for a (batch, seq_local) shard of token ids.
+
+    ``comm_sp`` is the sequence-parallel communicator (or None for a full
+    unsharded sequence); ``tokens`` holds this rank's contiguous sequence
+    block, rank-major.  With sp sharding, positional embeddings are indexed
+    at *global* positions (rank offset may be a traced ``lax.axis_index``).
+    """
+    b, s_local = tokens.shape
+    h = cfg.n_heads
+    if comm_sp is not None and comm_sp.size > 1:
+        offset = jnp.asarray(comm_sp.rank) * s_local
+    else:
+        offset = 0
+    pos = jax.lax.dynamic_slice_in_dim(params["pos"], offset, s_local, 0)
+
+    x = params["embed"][tokens] + pos[None]
+    d = x.shape[-1]
+    for blk in params["blocks"]:
+        y = _layer_norm(x, blk["ln1"])
+        qkv = y @ blk["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(b, s_local, h, d // h)
+        o = _attention(split(q), split(k), split(v), comm_sp, attn)
+        x = x + o.reshape(b, s_local, d) @ blk["wo"]
+        y = _layer_norm(x, blk["ln2"])
+        x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    x = _layer_norm(x, params["ln_f"])
+    return x @ params["unembed"]
+
+
+def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
+            attn: str = "ring", seq_global: Optional[int] = None):
+    """Mean next-token cross-entropy over the GLOBAL sequence.
+
+    The label for a shard's last token lives on the next sp rank — it is
+    fetched with a one-element ``ring_shift`` (the boundary token rides the
+    same differentiable transport as attention K/V; no gradient flows to a
+    label, but the collective must appear in every rank's program —
+    SURVEY.md §3.3).  The final global position has no successor and is
+    masked out; the sp-summed loss is normalized by the static global token
+    count."""
+    b, s_local = tokens.shape
+    sp = comm_sp.size if comm_sp is not None else 1
+    s_global = seq_global or sp * s_local
+
+    logits = forward(cfg, params, tokens, comm_sp, attn)
+
+    if sp > 1:
+        nxt = ring_shift(comm_sp, tokens[:, :1], shift=-1)
+        labels = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+        offset = jnp.asarray(comm_sp.rank) * s_local
+    else:
+        labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        offset = 0
+    global_pos = offset + jnp.arange(s_local)
+    mask = (global_pos < s_global - 1).astype(logits.dtype)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum(ce * mask[None, :])
+    if sp > 1:
+        total = comm_sp.Allreduce(local_sum, MPI_SUM)
+    else:
+        total = local_sum
+    return total / (b * (s_global - 1))
+
+
+def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
+               comm_dp=None, attn: str = "ring", lr: float = 1e-2):
+    """One SGD step; returns (loss, new_params).
+
+    DP follows the reference recipe exactly (parameter-averaging Allreduce
+    + loss Allreduce over the dp axis) so replicas stay in lock-step.  The
+    parameters are averaged over the sp axis as well: the sp-summed loss
+    (``Allreduce_sp`` in :func:`lm_loss`, with no ``1/sp``) scales each
+    rank's cotangents by ``sp``, and only the ``1/sp`` in the sp
+    param-averaging adjoint cancels it — the same load-bearing trick as the
+    reference's DP example (doc/examples.rst:46-65), applied per axis.
+    Jittable end-to-end — on a 2D mesh the whole step is one XLA program
+    mixing psum (dp/sp), the ppermute ring and masked collectives."""
+    def global_loss(p):
+        if comm_dp is not None and comm_dp.size > 1:
+            p = all_average_tree(comm_dp, p)
+        if comm_sp is not None and comm_sp.size > 1:
+            p = all_average_tree(comm_sp, p)
+        loss = lm_loss(cfg, p, tokens, comm_sp, attn)
+        if comm_dp is not None and comm_dp.size > 1:
+            loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+        return loss
+
+    loss, grads = jax.value_and_grad(global_loss)(params)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return loss, new_params

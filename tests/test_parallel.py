@@ -303,3 +303,30 @@ class TestDpHelpers:
             np.testing.assert_allclose(val, np.asarray(ref_val), rtol=1e-12)
             np.testing.assert_allclose(grad, np.asarray(ref_grad),
                                        rtol=1e-12, atol=1e-14)
+
+    def test_mlp_dp_train_step_rank_count_invariant(self):
+        # models.mlp's DP wrappers (over parallel.dp) keep replicas in
+        # lock-step and match the single-process full-data run.
+        from mpi4torch_tpu.models import mlp
+
+        rng = np.random.default_rng(12)
+        X = jnp.asarray(rng.standard_normal((16, 4)))
+        Y = jnp.asarray(rng.standard_normal((16, 2)))
+        p0 = mlp.init_params(jax.random.PRNGKey(2), (4, 8, 2),
+                             dtype=jnp.float64)
+
+        ref_loss, ref_params = mlp.dp_train_step(comm, p0, (X, Y), lr=0.1)
+
+        def body():
+            r = comm.rank
+            batch = (X[r * 4:(r + 1) * 4], Y[r * 4:(r + 1) * 4])
+            loss, params = mlp.dp_train_step(comm, p0, batch, lr=0.1)
+            return float(loss), jax.tree.map(np.asarray, params)
+
+        outs = mpi.run_ranks(body, NR)
+        for loss, params in outs:
+            np.testing.assert_allclose(loss, float(ref_loss), rtol=1e-12)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, np.asarray(b), rtol=1e-12, atol=1e-14),
+                params, ref_params)
