@@ -28,9 +28,12 @@ def _stale() -> bool:
     if not os.path.exists(_SO):
         return True
     so_mtime = os.path.getmtime(_SO)
-    return any(
-        os.path.getmtime(os.path.join(_HERE, src)) > so_mtime
-        for src in ("native.cc", "Makefile"))
+    try:
+        return any(
+            os.path.getmtime(os.path.join(_HERE, src)) > so_mtime
+            for src in ("native.cc", "Makefile"))
+    except OSError:
+        return False  # source-less install (prebuilt .so only): use it
 
 
 def _build() -> bool:

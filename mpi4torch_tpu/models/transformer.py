@@ -81,6 +81,8 @@ def _layer_norm(x, p):
 
 
 def _attention(q, k, v, comm_sp, attn: str):
+    if attn not in ("dense", "ring", "ulysses"):
+        raise ValueError(f"unknown attention strategy {attn!r}")
     if comm_sp is None or comm_sp.size == 1:
         return dense_attention(q, k, v, causal=True)
     if attn == "dense":
@@ -93,9 +95,7 @@ def _attention(q, k, v, comm_sp, attn: str):
         )
     if attn == "ring":
         return ring_attention(comm_sp, q, k, v, causal=True)
-    if attn == "ulysses":
-        return ulysses_attention(comm_sp, q, k, v, causal=True)
-    raise ValueError(f"unknown attention strategy {attn!r}")
+    return ulysses_attention(comm_sp, q, k, v, causal=True)
 
 
 def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
@@ -110,6 +110,13 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     b, s_local = tokens.shape
     h = cfg.n_heads
     if comm_sp is not None and comm_sp.size > 1:
+        if comm_sp.size * s_local > cfg.max_seq:
+            # Without this, dynamic_slice would clamp the high ranks' start
+            # offsets and silently reuse the last positional block.
+            raise ValueError(
+                f"global sequence {comm_sp.size * s_local} (sp="
+                f"{comm_sp.size} x s_local={s_local}) exceeds cfg.max_seq "
+                f"{cfg.max_seq}")
         offset = jnp.asarray(comm_sp.rank) * s_local
     else:
         offset = 0

@@ -14,18 +14,27 @@ ICI/DCN).
     ring       — differentiable ring shifts and halo exchange (Isend/Irecv)
     attention  — long-context attention: ring attention (CP) and Ulysses
                  all-to-all head/sequence attention (SP)
+    tp         — tensor parallelism: column/row-parallel layers
 """
 
-from . import attention, dp, ring
+from . import attention, dp, ring, tp
 
 from .dp import all_average_tree, dp_value_and_grad
 from .ring import halo_exchange, ring_shift
 from .attention import dense_attention, ring_attention, ulysses_attention
+from .tp import (
+    column_parallel_linear,
+    row_parallel_linear,
+    shard_axis,
+    tp_attention,
+    tp_mlp,
+)
 
 __all__ = [
     "attention",
     "dp",
     "ring",
+    "tp",
     "all_average_tree",
     "dp_value_and_grad",
     "halo_exchange",
@@ -33,4 +42,9 @@ __all__ = [
     "dense_attention",
     "ring_attention",
     "ulysses_attention",
+    "column_parallel_linear",
+    "row_parallel_linear",
+    "shard_axis",
+    "tp_attention",
+    "tp_mlp",
 ]
