@@ -27,19 +27,36 @@ from ..constants import MPI_SUM
 from ..parallel.attention import dense_attention, ring_attention, \
     ulysses_attention
 from ..parallel.dp import all_average_tree
+from ..parallel.moe import init_moe, moe_ffn, moe_ffn_dense
 from ..parallel.ring import ring_shift
 
 
 @dataclass(frozen=True)
 class TransformerConfig:
     """Static model hyperparameters (kept OUT of the parameter pytree so
-    grads/optimizer tree-maps see arrays only)."""
+    grads/optimizer tree-maps see arrays only).
+
+    ``n_experts > 0`` switches every block's FFN to an expert-parallel MoE
+    (capacity-based top-1 routing over the differentiable ``Alltoall``,
+    parallel/moe.py); ``capacity`` is the per-(expert, source-rank) slot
+    count, ``aux_coef`` weights the load-balancing loss in :func:`lm_loss`."""
     vocab: int
     d_model: int
     n_heads: int
     n_layers: int
     d_ff: int
     max_seq: int
+    n_experts: int = 0
+    capacity: int = 0
+    aux_coef: float = 0.01
+
+    def __post_init__(self):
+        if self.n_experts > 0 and self.capacity <= 0:
+            # capacity=0 would silently capacity-drop every token — the
+            # model would train with no FFN path at all.
+            raise ValueError(
+                f"n_experts={self.n_experts} requires capacity > 0, got "
+                f"{self.capacity}")
 
 
 def init_transformer(key, cfg: TransformerConfig,
@@ -51,7 +68,7 @@ def init_transformer(key, cfg: TransformerConfig,
         return jax.random.normal(key, (m, n), dtype) / jnp.sqrt(
             jnp.asarray(m, dtype))
 
-    keys = iter(jax.random.split(key, 4 + 6 * n_layers))
+    keys = iter(jax.random.split(key, 4 + 7 * n_layers))
     params: Dict[str, Any] = {
         "embed": jax.random.normal(next(keys), (vocab, d_model), dtype) * 0.02,
         "pos": jax.random.normal(next(keys), (max_seq, d_model), dtype) * 0.02,
@@ -61,16 +78,21 @@ def init_transformer(key, cfg: TransformerConfig,
         "blocks": [],
     }
     for _ in range(n_layers):
-        params["blocks"].append({
+        blk = {
             "ln1": {"scale": jnp.ones((d_model,), dtype),
                     "bias": jnp.zeros((d_model,), dtype)},
             "wqkv": dense(next(keys), d_model, 3 * d_model),
             "wo": dense(next(keys), d_model, d_model),
             "ln2": {"scale": jnp.ones((d_model,), dtype),
                     "bias": jnp.zeros((d_model,), dtype)},
-            "w1": dense(next(keys), d_model, d_ff),
-            "w2": dense(next(keys), d_ff, d_model),
-        })
+        }
+        if cfg.n_experts > 0:
+            blk["moe"] = init_moe(next(keys), cfg.n_experts, d_model, d_ff,
+                                  dtype)
+        else:
+            blk["w1"] = dense(next(keys), d_model, d_ff)
+            blk["w2"] = dense(next(keys), d_ff, d_model)
+        params["blocks"].append(blk)
     return params
 
 
@@ -99,13 +121,18 @@ def _attention(q, k, v, comm_sp, attn: str):
 
 
 def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
-            attn: str = "ring"):
+            attn: str = "ring", comm_ep=None, return_aux: bool = False):
     """Logits for a (batch, seq_local) shard of token ids.
 
     ``comm_sp`` is the sequence-parallel communicator (or None for a full
     unsharded sequence); ``tokens`` holds this rank's contiguous sequence
     block, rank-major.  With sp sharding, positional embeddings are indexed
     at *global* positions (rank offset may be a traced ``lax.axis_index``).
+
+    With ``cfg.n_experts > 0`` each block's FFN is the expert-parallel MoE
+    (experts sharded over ``comm_ep``; pass None to keep all experts
+    local).  ``return_aux`` additionally returns the summed load-balancing
+    loss.
     """
     b, s_local = tokens.shape
     h = cfg.n_heads
@@ -124,6 +151,7 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
 
     x = params["embed"][tokens] + pos[None]
     d = x.shape[-1]
+    aux_total = jnp.zeros((), x.dtype)
     for blk in params["blocks"]:
         y = _layer_norm(x, blk["ln1"])
         qkv = y @ blk["wqkv"]
@@ -132,13 +160,26 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
         o = _attention(split(q), split(k), split(v), comm_sp, attn)
         x = x + o.reshape(b, s_local, d) @ blk["wo"]
         y = _layer_norm(x, blk["ln2"])
-        x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+        if cfg.n_experts > 0:
+            flat = y.reshape(b * s_local, d)
+            if comm_ep is not None and comm_ep.size > 1:
+                ff, aux = moe_ffn(comm_ep, flat, blk["moe"], cfg.capacity)
+            else:
+                ff, aux = moe_ffn_dense(flat, blk["moe"], cfg.capacity)
+            x = x + ff.reshape(b, s_local, d)
+            aux_total = aux_total + aux
+        else:
+            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
     x = _layer_norm(x, params["ln_f"])
-    return x @ params["unembed"]
+    logits = x @ params["unembed"]
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
-            attn: str = "ring", seq_global: Optional[int] = None):
+            attn: str = "ring", seq_global: Optional[int] = None,
+            comm_ep=None):
     """Mean next-token cross-entropy over the GLOBAL sequence.
 
     The label for a shard's last token lives on the next sp rank — it is
@@ -152,7 +193,12 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
     sp = comm_sp.size if comm_sp is not None else 1
     s_global = seq_global or sp * s_local
 
-    logits = forward(cfg, params, tokens, comm_sp, attn)
+    if cfg.n_experts > 0:
+        logits, aux = forward(cfg, params, tokens, comm_sp, attn,
+                              comm_ep=comm_ep, return_aux=True)
+    else:
+        logits = forward(cfg, params, tokens, comm_sp, attn)
+        aux = None
 
     if sp > 1:
         nxt = ring_shift(comm_sp, tokens[:, :1], shift=-1)
@@ -171,11 +217,20 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
         total = comm_sp.Allreduce(local_sum, MPI_SUM)
     else:
         total = local_sum
-    return total / (b * (s_global - 1))
+    loss = total / (b * (s_global - 1))
+    if aux is not None:
+        if sp > 1:
+            # Each sp rank's aux reflects only its own sequence shard's
+            # routing; average it so the loss stays rank-identical (the
+            # lock-step invariant every collective loss must keep).
+            aux = comm_sp.Allreduce(aux, MPI_SUM) / sp
+        loss = loss + cfg.aux_coef * aux
+    return loss
 
 
 def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
-               comm_dp=None, attn: str = "ring", lr: float = 1e-2):
+               comm_dp=None, attn: str = "ring", lr: float = 1e-2,
+               comm_ep=None):
     """One SGD step; returns (loss, new_params).
 
     DP follows the reference recipe exactly (parameter-averaging Allreduce
@@ -192,7 +247,7 @@ def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
             p = all_average_tree(comm_dp, p)
         if comm_sp is not None and comm_sp.size > 1:
             p = all_average_tree(comm_sp, p)
-        loss = lm_loss(cfg, p, tokens, comm_sp, attn)
+        loss = lm_loss(cfg, p, tokens, comm_sp, attn, comm_ep=comm_ep)
         if comm_dp is not None and comm_dp.size > 1:
             loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
         return loss

@@ -15,9 +15,10 @@ ICI/DCN).
     attention  — long-context attention: ring attention (CP) and Ulysses
                  all-to-all head/sequence attention (SP)
     tp         — tensor parallelism: column/row-parallel layers
+    moe        — expert parallelism: capacity-based MoE over Alltoall
 """
 
-from . import attention, dp, ring, tp
+from . import attention, dp, moe, ring, tp
 
 from .dp import all_average_tree, dp_value_and_grad
 from .ring import halo_exchange, ring_shift
@@ -29,10 +30,12 @@ from .tp import (
     tp_attention,
     tp_mlp,
 )
+from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
 
 __all__ = [
     "attention",
     "dp",
+    "moe",
     "ring",
     "tp",
     "all_average_tree",
@@ -47,4 +50,8 @@ __all__ = [
     "shard_axis",
     "tp_attention",
     "tp_mlp",
+    "init_moe",
+    "moe_ffn",
+    "moe_ffn_dense",
+    "top1_route",
 ]
