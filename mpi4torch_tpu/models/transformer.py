@@ -242,6 +242,19 @@ def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
     reference's DP example (doc/examples.rst:46-65), applied per axis.
     Jittable end-to-end — on a 2D mesh the whole step is one XLA program
     mixing psum (dp/sp), the ppermute ring and masked collectives."""
+    if (comm_ep is not None and comm_ep.size > 1
+            and not (comm_dp is not None and comm_dp.size > 1)
+            and not (comm_sp is not None and comm_sp.size > 1)):
+        # EP alone leaves local-path gradients (gate, embeddings,
+        # attention) rank-varying while expert weights are presumed
+        # replicated: after one update the shard_axis slices in moe_ffn
+        # would silently read inconsistent experts.  An averaging axis
+        # (dp or sp) covering the EP ranks restores lock-step.
+        raise ValueError(
+            "train_step with comm_ep requires a covering comm_dp or "
+            "comm_sp (EP ranks hold different token shards; without a "
+            "param-averaging axis the replicated parameters desync)")
+
     def global_loss(p):
         if comm_dp is not None and comm_dp.size > 1:
             p = all_average_tree(comm_dp, p)

@@ -16,9 +16,10 @@ ICI/DCN).
                  all-to-all head/sequence attention (SP)
     tp         — tensor parallelism: column/row-parallel layers
     moe        — expert parallelism: capacity-based MoE over Alltoall
+    pp         — pipeline parallelism: GPipe fill-drain over Isend/Irecv
 """
 
-from . import attention, dp, moe, ring, tp
+from . import attention, dp, moe, pp, ring, tp
 
 from .dp import all_average_tree, dp_value_and_grad
 from .ring import halo_exchange, ring_shift
@@ -31,6 +32,7 @@ from .tp import (
     tp_mlp,
 )
 from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
+from .pp import pipeline_spmd, pipeline_step, recv_activation, send_activation
 
 __all__ = [
     "attention",
@@ -54,4 +56,8 @@ __all__ = [
     "moe_ffn",
     "moe_ffn_dense",
     "top1_route",
+    "pipeline_spmd",
+    "pipeline_step",
+    "recv_activation",
+    "send_activation",
 ]

@@ -56,7 +56,7 @@ class TestTop1Route:
 class TestMoEFFN:
     def test_eager_matches_dense_oracle(self):
         params, xs = make()
-        expects = [np.asarray(moe_ffn_dense(x, params, CAP)[0]) for x in xs]
+        oracle = [moe_ffn_dense(x, params, CAP) for x in xs]
 
         def body():
             y, aux = moe_ffn(comm, xs[int(comm.rank)], params, CAP)
@@ -64,8 +64,13 @@ class TestMoEFFN:
 
         outs = mpi.run_ranks(body, NR)
         for r in range(NR):
-            np.testing.assert_allclose(outs[r][0], expects[r], rtol=1e-10,
-                                       atol=1e-12, err_msg=f"rank {r}")
+            np.testing.assert_allclose(outs[r][0], np.asarray(oracle[r][0]),
+                                       rtol=1e-10, atol=1e-12,
+                                       err_msg=f"rank {r}")
+            # aux (routing statistics of the local shard) must match too —
+            # it feeds the training loss via cfg.aux_coef.
+            np.testing.assert_allclose(outs[r][1], float(oracle[r][1]),
+                                       rtol=1e-12, err_msg=f"rank {r} aux")
 
     def test_spmd_matches_dense_oracle(self):
         params, xs = make(1)
