@@ -1,0 +1,81 @@
+"""Data-parallel ResNet-18 on CIFAR-10-shaped data (BASELINE.md config #4).
+
+The classic DDP recipe over mpi4torch_tpu's differentiable Allreduce: each
+rank computes a local backward on its batch shard, then every parameter
+gradient is averaged with one ``Allreduce(g, MPI_SUM) / size`` — the
+per-param-grad pattern the reference enables but leaves to the user
+(reference: README.md:34-46).  The whole step (forward, backward, N
+gradient Allreduces, SGD update) is ONE jitted XLA program per rank; under
+the SPMD mesh backend the Allreduces lower to ``psum`` over ICI.
+
+Data is synthetic CIFAR-10-shaped (32x32x3, 10 classes) so the example runs
+hermetically; swap ``make_synthetic_cifar`` for real numpy CIFAR batches and
+nothing else changes.
+
+Run:  python examples/resnet_cifar_dp.py [nranks] [steps]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+if os.environ.get("MPI4TORCH_TPU_REAL_DEVICES") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu.models import resnet as R
+
+comm = mpi.COMM_WORLD
+
+CFG = R.ResNetConfig(num_classes=10)
+BATCH_PER_RANK = 8
+IMAGE_HW = 32
+
+
+def make_synthetic_cifar(seed, n, hw, num_classes):
+    rng = np.random.default_rng(seed)
+    images = rng.standard_normal((n, hw, hw, 3)).astype(np.float32)
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def main(steps: int = 3, cfg: R.ResNetConfig = CFG, hw: int = IMAGE_HW,
+         batch_per_rank: int = BATCH_PER_RANK):
+    params, state = R.init_resnet(jax.random.PRNGKey(0), cfg)
+
+    # Every rank generates the full batch and slices its shard — the same
+    # derive-local-from-rank discipline the tests use.
+    images, labels = make_synthetic_cifar(
+        7, comm.size * batch_per_rank, hw, cfg.num_classes)
+    start = jnp.asarray(comm.rank) * batch_per_rank
+    batch = (jax.lax.dynamic_slice_in_dim(images, start, batch_per_rank, 0),
+             jax.lax.dynamic_slice_in_dim(labels, start, batch_per_rank, 0))
+
+    losses = []
+    for _ in range(steps):
+        loss, params, state = R.dp_grad_train_step(
+            comm, cfg, params, state, batch, lr=0.05)
+        losses.append(float(loss))
+
+    if comm.rank == 0:
+        for i, l in enumerate(losses):
+            print(f"step {i}: global loss {l:.4f}")
+    head_w = np.asarray(params["head"]["w"])
+    return losses, head_w
+
+
+if __name__ == "__main__":
+    nranks = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    results = mpi.run_ranks(lambda: main(steps), nranks)
+    losses0, head0 = results[0]
+    assert all(np.array_equal(head0, h) for _, h in results), "ranks diverged"
+    assert losses0[-1] < losses0[0], losses0
+    print(f"OK: {nranks}-rank DP ResNet-18 stayed in lock-step and the loss "
+          f"fell {losses0[0]:.4f} -> {losses0[-1]:.4f}")

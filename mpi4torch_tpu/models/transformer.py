@@ -241,28 +241,30 @@ def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
     param-averaging adjoint cancels it — the same load-bearing trick as the
     reference's DP example (doc/examples.rst:46-65), applied per axis.
     Jittable end-to-end — on a 2D mesh the whole step is one XLA program
-    mixing psum (dp/sp), the ppermute ring and masked collectives."""
-    if (comm_ep is not None and comm_ep.size > 1
-            and not (comm_dp is not None and comm_dp.size > 1)
-            and not (comm_sp is not None and comm_sp.size > 1)):
-        # EP alone leaves local-path gradients (gate, embeddings,
-        # attention) rank-varying while expert weights are presumed
-        # replicated: after one update the shard_axis slices in moe_ffn
-        # would silently read inconsistent experts.  An averaging axis
-        # (dp or sp) covering the EP ranks restores lock-step.
-        raise ValueError(
-            "train_step with comm_ep requires a covering comm_dp or "
-            "comm_sp (EP ranks hold different token shards; without a "
-            "param-averaging axis the replicated parameters desync)")
+    mixing psum (dp/sp), the ppermute ring and masked collectives.
+
+    The ep axis is treated as a *data* axis with the same recipe (ep ranks
+    hold different token shards): parameters are averaged over ep and the
+    loss is ep-averaged too.  This keeps every replicated leaf — gate,
+    embeddings, attention, and the (logically replicated) expert tensors
+    that :func:`~mpi4torch_tpu.parallel.moe.moe_ffn` slices per rank — in
+    lock-step, and makes gradients match the dense single-rank oracle
+    (tests/test_transformer.py): adjoint-Allreduce sums each rank's
+    cotangents, and an expert block's whole-mesh gradient already
+    accumulates on its owner rank via the adjoint Alltoall."""
 
     def global_loss(p):
         if comm_dp is not None and comm_dp.size > 1:
             p = all_average_tree(comm_dp, p)
         if comm_sp is not None and comm_sp.size > 1:
             p = all_average_tree(comm_sp, p)
+        if comm_ep is not None and comm_ep.size > 1:
+            p = all_average_tree(comm_ep, p)
         loss = lm_loss(cfg, p, tokens, comm_sp, attn, comm_ep=comm_ep)
         if comm_dp is not None and comm_dp.size > 1:
             loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+        if comm_ep is not None and comm_ep.size > 1:
+            loss = comm_ep.Allreduce(loss, MPI_SUM) / comm_ep.size
         return loss
 
     loss, grads = jax.value_and_grad(global_loss)(params)

@@ -157,6 +157,47 @@ class TestMoETransformer:
             np.testing.assert_allclose(outs[r], expects[r], rtol=1e-9,
                                        atol=1e-11, err_msg=f"rank {r}")
 
+    def test_ep_only_train_step_matches_dense_oracle(self):
+        """EP-only train_step (comm_ep, no dp/sp) == dense single-rank
+        train_step on the concatenated batch: the ep axis is a data axis —
+        param-averaging + loss-averaging over ep reproduce the full-batch
+        gradients exactly (aux_coef=0: the load-balance penalty is
+        nonlinear in batch composition, so only the CE term admits an
+        exact partition oracle)."""
+        from mpi4torch_tpu.models import transformer as Tr
+
+        cfg = Tr.TransformerConfig(vocab=16, d_model=8, n_heads=2,
+                                   n_layers=1, d_ff=16, max_seq=8,
+                                   n_experts=4, capacity=32, aux_coef=0.0)
+        params = Tr.init_transformer(jax.random.PRNGKey(2), cfg,
+                                     dtype=jnp.float64)
+        rng = np.random.default_rng(2)
+        toks = [jnp.asarray(rng.integers(0, 16, (2, 8))) for _ in range(NR)]
+        full = jnp.concatenate(toks, axis=0)
+        ref_loss, ref_params = Tr.train_step(cfg, params, full, lr=0.1)
+
+        def body():
+            r = int(comm.rank)
+            loss, new_p = Tr.train_step(cfg, params, toks[r],
+                                        comm_ep=comm, lr=0.1)
+            return (float(loss),
+                    np.asarray(new_p["embed"]),
+                    np.asarray(new_p["blocks"][0]["moe"]["w1"]),
+                    np.asarray(new_p["blocks"][0]["moe"]["gate"]))
+
+        outs = mpi.run_ranks(body, NR)
+        for r, (loss, embed, w1, gate) in enumerate(outs):
+            np.testing.assert_allclose(loss, float(ref_loss), rtol=1e-12,
+                                       err_msg=f"rank {r}")
+            np.testing.assert_allclose(embed, np.asarray(ref_params["embed"]),
+                                       rtol=1e-9, atol=1e-12)
+            np.testing.assert_allclose(
+                w1, np.asarray(ref_params["blocks"][0]["moe"]["w1"]),
+                rtol=1e-9, atol=1e-12)
+            np.testing.assert_allclose(
+                gate, np.asarray(ref_params["blocks"][0]["moe"]["gate"]),
+                rtol=1e-9, atol=1e-12)
+
     def test_moe_train_step_runs_and_lockstep(self):
         from mpi4torch_tpu.models import transformer as Tr
 

@@ -1,0 +1,159 @@
+"""ResNet-18 DP tests — BASELINE.md parity config #4.
+
+Oracles, in the reference's test style (SURVEY.md §4 — analytic/single-rank
+oracles + rank-conditional identity checks):
+
+* eval-mode DP gradients == single-rank full-batch gradients (mean CE is
+  linear in the batch partition once BN stats are frozen);
+* lock-step: every rank's updated params are bit-identical after a step;
+* the two DP recipes (per-param-grad Allreduce vs in-loss adjoint
+  Allreduce) produce identical updates;
+* training reduces the loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import COMM_WORLD as comm
+from mpi4torch_tpu.models import resnet as R
+
+NR = 4
+CFG = R.ResNetConfig(num_classes=10, stage_sizes=(1, 1), widths=(8, 16))
+B_LOCAL = 2
+B_GLOBAL = NR * B_LOCAL
+HW = 8
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    images = jnp.asarray(rng.standard_normal((B_GLOBAL, HW, HW, 3)))
+    labels = jnp.asarray(rng.integers(0, CFG.num_classes, B_GLOBAL))
+    return images, labels
+
+
+def make_params():
+    return R.init_resnet(jax.random.PRNGKey(0), CFG, dtype=jnp.float64)
+
+
+def local_batch(images, labels, rank):
+    start = jnp.asarray(rank) * B_LOCAL
+    return (jax.lax.dynamic_slice_in_dim(images, start, B_LOCAL, 0),
+            jax.lax.dynamic_slice_in_dim(labels, start, B_LOCAL, 0))
+
+
+class TestForward:
+    def test_shapes_and_state(self):
+        params, state = make_params()
+        images, _ = make_data()
+        logits, new_state = R.forward(CFG, params, state, images, train=True)
+        assert logits.shape == (B_GLOBAL, CFG.num_classes)
+        # Train mode must move the running stats off their init.
+        stem = new_state["stem"]["bn"]
+        assert not np.allclose(np.asarray(stem["mean"]), 0.0)
+
+    def test_eval_mode_uses_state(self):
+        params, state = make_params()
+        images, _ = make_data()
+        logits, new_state = R.forward(CFG, params, state, images, train=False)
+        chex_same = jax.tree.map(
+            lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+            state, new_state)
+        assert all(jax.tree.leaves(chex_same))
+        assert np.all(np.isfinite(np.asarray(logits)))
+
+    def test_jit_compiles(self):
+        params, state = make_params()
+        images, _ = make_data()
+        f = jax.jit(lambda p, s, x: R.forward(CFG, p, s, x, train=True))
+        logits, _ = f(params, state, images)
+        assert logits.shape == (B_GLOBAL, CFG.num_classes)
+
+
+class TestDPGradParity:
+    """Eval-mode BN makes mean-CE linear in the batch partition: the
+    rank-averaged DP gradient must equal the single-rank full-batch
+    gradient."""
+
+    def _single_rank(self):
+        params, state = make_params()
+        images, labels = make_data()
+        loss, grads = jax.value_and_grad(
+            lambda p: R.local_loss(CFG, p, state, (images, labels),
+                                   train=False)[0])(params)
+        return params, state, images, labels, loss, grads
+
+    def test_grad_recipe_matches_single_rank(self):
+        params, state, images, labels, ref_loss, ref_grads = \
+            self._single_rank()
+
+        def body():
+            batch = local_batch(images, labels, comm.rank)
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: R.local_loss(CFG, p, state, batch, train=False),
+                has_aux=True)(params)
+            grads = jax.tree.map(
+                lambda g: comm.Allreduce(g, mpi.MPI_SUM) / comm.size, grads)
+            loss = comm.Allreduce(loss, mpi.MPI_SUM) / comm.size
+            return loss, grads
+
+        # run_spmd stacks outputs along a leading per-rank axis.
+        loss, grads = mpi.run_spmd(body, nranks=NR)()
+        np.testing.assert_allclose(np.asarray(loss), ref_loss, rtol=1e-12)
+        for g, rg in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            got = np.asarray(g)
+            for r in range(1, NR):  # allreduced grads are rank-identical
+                np.testing.assert_array_equal(got[0], got[r])
+            np.testing.assert_allclose(got[0], np.asarray(rg),
+                                       rtol=1e-9, atol=1e-12)
+
+
+class TestLockStep:
+    def test_replicas_identical_and_recipes_agree(self):
+        params, state = make_params()
+        images, labels = make_data()
+
+        def step_with(recipe):
+            def body():
+                batch = local_batch(images, labels, comm.rank)
+                loss, new_p, new_s = recipe(comm, CFG, params, state, batch,
+                                            lr=0.05)
+                return loss, new_p
+            return mpi.run_spmd(body, nranks=NR)()
+
+        loss_g, params_g = step_with(R.dp_grad_train_step)
+        loss_l, params_l = step_with(R.dp_loss_train_step)
+
+        # run_spmd returns per-rank-stacked outputs; every rank identical.
+        for leaf in jax.tree.leaves(params_g):
+            arr = np.asarray(leaf)
+            for r in range(1, NR):
+                np.testing.assert_array_equal(arr[0], arr[r])
+
+        np.testing.assert_allclose(np.asarray(loss_g), np.asarray(loss_l),
+                                   rtol=1e-12)
+        for a, b in zip(jax.tree.leaves(params_g), jax.tree.leaves(params_l)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-9, atol=1e-12)
+
+
+class TestTraining:
+    def test_loss_decreases(self):
+        params, state = make_params()
+        images, labels = make_data()
+
+        def body():
+            p, s = params, state
+            losses = []
+            for _ in range(4):
+                batch = local_batch(images, labels, comm.rank)
+                loss, p, s = R.dp_grad_train_step(comm, CFG, p, s, batch,
+                                                  lr=0.05)
+                losses.append(loss)
+            return jnp.stack(losses)
+
+        losses = np.asarray(mpi.run_spmd(body, nranks=NR)())
+        first, last = losses[..., 0], losses[..., -1]
+        assert np.all(last < first)
