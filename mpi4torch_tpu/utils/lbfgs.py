@@ -24,12 +24,40 @@ import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
 
-def _dot(a, b):
+def _local_dot(a, b):
     return float(jnp.vdot(a, b))
 
 
+def _make_reducers(comm):
+    """(dot, max_abs, sum_abs) over the optimization variable.
+
+    With a communicator the variable is *domain-decomposed* (each rank owns
+    a disjoint slice, e.g. the stencil example's row block) and every
+    scalar the algorithm branches on must be the GLOBAL reduction —
+    otherwise ranks take different line-search branches and the collectives
+    inside ``loss_fn`` deadlock (SURVEY.md §3.3: every rank must execute
+    the same communication sequence).  Without one, the variable is
+    replicated and local reductions are already rank-identical."""
+    if comm is None or comm.size == 1:
+        return (_local_dot,
+                lambda a: float(jnp.max(jnp.abs(a))),
+                lambda a: float(jnp.sum(jnp.abs(a))))
+    from ..constants import MPI_MAX, MPI_SUM
+
+    def dot(a, b):
+        return float(comm.Allreduce(jnp.vdot(a, b), MPI_SUM))
+
+    def max_abs(a):
+        return float(comm.Allreduce(jnp.max(jnp.abs(a)), MPI_MAX))
+
+    def sum_abs(a):
+        return float(comm.Allreduce(jnp.sum(jnp.abs(a)), MPI_SUM))
+
+    return dot, max_abs, sum_abs
+
+
 def _strong_wolfe(fg, x, d, f0, g0, *, c1=1e-4, c2=0.9, max_evals=25,
-                  t0=1.0):
+                  t0=1.0, _dot=_local_dot):
     """Standard bracket+zoom strong-Wolfe line search on phi(t) = f(x+t*d).
 
     Returns (t, f_t, g_t, n_evals).  Falls back to the best point seen if
@@ -93,7 +121,7 @@ def _strong_wolfe(fg, x, d, f0, g0, *, c1=1e-4, c2=0.9, max_evals=25,
 def minimize_lbfgs(loss_fn: Callable, params, *, max_iter: int = 20,
                    history_size: int = 10, tolerance_grad: float = 1e-10,
                    tolerance_change: float = 1e-12,
-                   value_and_grad: bool = False):
+                   value_and_grad: bool = False, comm=None):
     """Minimize ``loss_fn(params)`` with L-BFGS (two-loop recursion, strong
     Wolfe).  ``params`` may be any pytree.  Returns ``(params, final_loss)``.
 
@@ -101,9 +129,16 @@ def minimize_lbfgs(loss_fn: Callable, params, *, max_iter: int = 20,
     inside ``loss_fn`` run in rank lock-step — the eager analogue of
     ``torch.optim.LBFGS`` driving the reference's distributed closure
     (reference: examples/simple_linear_regression.py:40-53).
-    """
+
+    Pass ``comm`` when ``params`` is domain-decomposed across ranks (each
+    rank optimizes its own disjoint slice of one global variable, and
+    ``loss_fn`` returns the Allreduce'd global loss): all inner products
+    and norms the algorithm branches on are then globally reduced, keeping
+    ranks' control flow in lock-step.  Leave it ``None`` for replicated
+    parameters (the reference's DP recipe)."""
     x0, unravel = ravel_pytree(params)
     fg_tree = loss_fn if value_and_grad else jax.value_and_grad(loss_fn)
+    _dot, _max_abs, _sum_abs = _make_reducers(comm)
 
     def fg(xflat):
         f, g = fg_tree(unravel(xflat))
@@ -116,7 +151,7 @@ def minimize_lbfgs(loss_fn: Callable, params, *, max_iter: int = 20,
     rho_hist: List = []
 
     for _ in range(max_iter):
-        if float(jnp.max(jnp.abs(g))) <= tolerance_grad:
+        if _max_abs(g) <= tolerance_grad:
             break
         # Two-loop recursion
         q = g
@@ -138,9 +173,9 @@ def minimize_lbfgs(loss_fn: Callable, params, *, max_iter: int = 20,
             r = r + s * (a - b)
         d = -r
 
-        t0 = min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-300)) \
+        t0 = min(1.0, 1.0 / max(_sum_abs(g), 1e-300)) \
             if not y_hist else 1.0
-        t, f_new, g_new, _ = _strong_wolfe(fg, x, d, f, g, t0=t0)
+        t, f_new, g_new, _ = _strong_wolfe(fg, x, d, f, g, t0=t0, _dot=_dot)
         if t == 0.0:
             break
         x_new = x + t * d
@@ -155,7 +190,7 @@ def minimize_lbfgs(loss_fn: Callable, params, *, max_iter: int = 20,
                 s_hist.pop(0)
                 y_hist.pop(0)
                 rho_hist.pop(0)
-        if float(jnp.max(jnp.abs(s))) <= tolerance_change:
+        if _max_abs(s) <= tolerance_change:
             x, f, g = x_new, f_new, g_new
             break
         x, f, g = x_new, f_new, g_new
@@ -169,19 +204,22 @@ class LBFGS:
 
         opt = LBFGS(max_iter=20)
         params, loss = opt.step(lossfn, params)
-    """
+
+    ``comm`` enables the domain-decomposed mode (see
+    :func:`minimize_lbfgs`)."""
 
     def __init__(self, max_iter: int = 20, history_size: int = 10,
                  tolerance_grad: float = 1e-10,
-                 tolerance_change: float = 1e-12):
+                 tolerance_change: float = 1e-12, comm=None):
         self.max_iter = max_iter
         self.history_size = history_size
         self.tolerance_grad = tolerance_grad
         self.tolerance_change = tolerance_change
+        self.comm = comm
 
     def step(self, loss_fn: Callable, params) -> Tuple:
         return minimize_lbfgs(
             loss_fn, params, max_iter=self.max_iter,
             history_size=self.history_size,
             tolerance_grad=self.tolerance_grad,
-            tolerance_change=self.tolerance_change)
+            tolerance_change=self.tolerance_change, comm=self.comm)
