@@ -86,6 +86,38 @@ class MPI_Communicator:
     def __init__(self, backend_resolver=None):
         self._resolver = backend_resolver
 
+    # ------------------------------------------------------------- pickling
+
+    def __reduce__(self):
+        """Serialization, world-only (reference: csrc/extension.cpp:1283-1297
+        ``def_pickle``).
+
+        The reference serializes only ``MPI_COMM_WORLD`` — and its
+        deserializer's condition is inverted, throwing precisely on the
+        valid string it wrote (SURVEY.md §2.1, the documented latent bug).
+        This build keeps the world-only restriction (a mesh-axis
+        communicator captures live device objects that have no stable
+        serialized identity) but with working semantics: the round trip
+        restores the :data:`COMM_WORLD` singleton, which re-resolves its
+        backend in the deserializing process."""
+        if self._resolver is None:
+            return (_restore_comm_world, ())
+        import pickle
+        raise pickle.PicklingError(
+            "Unsupported communicator for serialization: only COMM_WORLD "
+            "can be pickled (mesh-derived communicators hold live device "
+            "references; rebuild them with comm_from_mesh after loading)")
+
+    def __copy__(self):
+        # Handle semantics: a communicator denotes a process group, it is
+        # not data — copying a structure that contains one (train-state
+        # pytrees, configs) must hand back the same handle, for every
+        # communicator kind, decoupled from the world-only pickle rule.
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
     # -------------------------------------------------------------- backend
 
     def _backend(self):
@@ -228,6 +260,14 @@ def _default_resolver():
         from .ops import spmd as _spmd
         return _spmd.SpmdBackend(spmd_ctx)
     return _EagerBackend(effective_rank_context())
+
+
+def _restore_comm_world():
+    """Unpickle target: the COMM_WORLD singleton (its backend re-resolves
+    in the loading process, so a communicator pickled on rank r of one run
+    is THE world of whatever context deserializes it — the only portable
+    meaning, and what the reference's broken deserializer intended)."""
+    return COMM_WORLD
 
 
 COMM_WORLD = MPI_Communicator()

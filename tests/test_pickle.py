@@ -1,0 +1,86 @@
+"""Communicator serialization — reference parity with fixed semantics.
+
+The reference pickles only ``MPI_COMM_WORLD`` and its deserializer throws
+on the very string it wrote (inverted condition, csrc/extension.cpp:
+1290-1296 — SURVEY.md §2.1 documents it as a latent bug).  Here the round
+trip must actually work: COMM_WORLD restores to the singleton and is
+immediately usable; mesh-derived communicators refuse to pickle with a
+clear message."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import COMM_WORLD as comm
+
+
+class TestCommWorldPickle:
+    def test_round_trip_restores_singleton(self):
+        blob = pickle.dumps(comm)
+        restored = pickle.loads(blob)
+        assert restored is mpi.COMM_WORLD
+
+    def test_restored_comm_is_usable_eager(self):
+        restored = pickle.loads(pickle.dumps(comm))
+
+        def body():
+            x = jnp.full(3, float(restored.rank) + 1.0)
+            return np.asarray(restored.Allreduce(x, mpi.MPI_SUM))
+
+        outs = mpi.run_ranks(body, 4)
+        for o in outs:
+            np.testing.assert_array_equal(o, np.full(3, 10.0))
+
+    def test_restored_comm_is_usable_spmd(self):
+        restored = pickle.loads(pickle.dumps(comm))
+
+        def body():
+            return restored.Allreduce(jnp.ones(2), mpi.MPI_SUM)
+
+        out = np.asarray(mpi.run_spmd(body, nranks=4)())
+        np.testing.assert_array_equal(out, np.full((4, 2), 4.0))
+
+    def test_pickle_inside_rank_context(self):
+        # Pickled on a rank thread, the blob still denotes the world —
+        # not a rank-bound view (rank binding is resolved at use time).
+        def body():
+            return pickle.dumps(comm)
+
+        blobs = mpi.run_ranks(body, 2)
+        assert pickle.loads(blobs[0]) is mpi.COMM_WORLD
+        assert blobs[0] == blobs[1]
+
+
+class TestMeshCommRefusesPickle:
+    def test_mesh_comm_raises_with_guidance(self):
+        from jax.sharding import Mesh
+
+        devs = np.asarray(jax.devices()[:2])
+        mesh = Mesh(devs, ("x",))
+        c = mpi.comm_from_mesh(mesh, "x")
+        with pytest.raises(pickle.PicklingError,
+                           match="only COMM_WORLD"):
+            pickle.dumps(c)
+
+
+class TestCopySemantics:
+    def test_copy_returns_same_handle_for_every_kind(self):
+        # Communicators are handles, not data: copying a pytree/config
+        # holding one must succeed for ALL kinds (including mesh-derived,
+        # which refuses to pickle) and hand back the same handle.
+        import copy
+
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("x",))
+        for c in (comm, mpi.comm_from_mesh(mesh, "x")):
+            assert copy.copy(c) is c
+            assert copy.deepcopy(c) is c
+            state = {"comm": c, "params": [jnp.ones(2)]}
+            state2 = copy.deepcopy(state)
+            assert state2["comm"] is c
+            assert state2["params"] is not state["params"]
