@@ -25,7 +25,17 @@ import time
 
 
 def main() -> None:
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # The env var alone does not stop an externally-registered TPU
+        # plugin (axon) from initializing — and its init can hang on a
+        # flaky tunnel.  The explicit config update does (same pin as
+        # tests/conftest.py).  Real-TPU runs leave JAX_PLATFORMS unset.
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     import mpi4torch_tpu as mpi

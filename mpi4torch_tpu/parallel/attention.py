@@ -47,57 +47,53 @@ def dense_attention(q, k, v, causal: bool = False, q_offset=0, kv_offset=0):
     return jnp.einsum("bqhk,bkhd->bqhd", probs, v)
 
 
-def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0):
+def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
+                   impl: str = "auto"):
     """Blockwise ring attention over the sequence axis (context parallel).
 
     Each rank holds one sequence block of q/k/v.  K/V blocks circulate the
-    ring; the local output accumulates through a numerically stable online
-    softmax, so the result equals dense attention over the full sequence
-    without any rank ever materializing it — O(seq/ranks) memory per rank.
-    Gradients ride the reverse ring automatically (the transport is the
-    differentiable ``ring_shift``).
+    ring; the local result accumulates by merging normalized block
+    partials (``(out, lse)`` online-softmax combination), so it equals
+    dense attention over the full sequence without any rank ever
+    materializing it — O(seq/ranks) memory per rank.  Gradients ride the
+    reverse ring automatically (the transport is the differentiable
+    ``ring_shift``).
+
+    The per-block compute is :func:`~mpi4torch_tpu.ops.flash.
+    flash_block_attention`: on eligible TPU shapes the fused Pallas kernel
+    (scores never hit HBM), otherwise the jnp path; ``impl`` forces a
+    path (tests pin both against the dense oracle).
     """
+    from ..ops.flash import flash_block_attention, merge_partials
+
     size = comm.size
-    dtype = q.dtype
     b, s_local, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype))
 
     # Global block positions: rank may be symbolic (lax.axis_index) under
     # SPMD tracing; all masking is array arithmetic (SURVEY.md §7 hard
     # part 4 — rank-dependent values under a single trace).
     my_rank = jnp.asarray(comm.rank)
-    q_pos = my_rank * s_local + jnp.arange(s_local)
+    q_off = my_rank * s_local
 
-    m = jnp.full((b, s_local, h), _NEG_BIG, dtype)
-    l = jnp.zeros((b, s_local, h), dtype)
-    acc = jnp.zeros((b, s_local, h, d), dtype)
-
+    out = None
+    lse = None
     for step in range(size):
         # After `step` +1-shifts the local K/V block originated on rank
         # (my_rank - step) % size.
         owner = (my_rank - step) % size
-        kv_pos = owner * s_local + jnp.arange(s_local)
-
-        scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
-        if causal:
-            mask = q_pos[:, None] >= kv_pos[None, :]
-            scores = jnp.where(mask[:, None, :], scores,
-                               jnp.asarray(_NEG_BIG, dtype))
-        block_max = jnp.max(scores, axis=-1)            # (b, s, h)
-        m_new = jnp.maximum(m, block_max)
-        p = jnp.exp(scores - m_new[..., None])
-        if causal:
-            p = jnp.where(mask[:, None, :], p, jnp.zeros([], dtype))
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum("bqhk,bkhd->bqhd", p, v)
-        m = m_new
+        o_b, lse_b = flash_block_attention(
+            q, k, v, causal=causal, q_offset=q_off,
+            kv_offset=owner * s_local, impl=impl)
+        if out is None:
+            out, lse = o_b, lse_b
+        else:
+            out, lse = merge_partials(out, lse, o_b, lse_b)
 
         if step + 1 < size:
             k = ring_shift(comm, k, 1, tag + 2 * step)
             v = ring_shift(comm, v, 1, tag + 2 * step + 1)
 
-    return acc / l[..., None]
+    return out
 
 
 def ulysses_attention(comm, q, k, v, causal: bool = False):
