@@ -237,9 +237,37 @@ def _block_fwd(q, k, v, q_off, kv_off, causal, impl):
     return (out, lse), (q, k, v, q_off, kv_off, out, lse)
 
 
+# Backward recomputation is KV-tiled beyond this many keys so the rebuilt
+# score slab stays (b, sq, h, _KV_TILE) instead of (b, sq, h, sk) — the
+# memory the fused forward saves must not reappear transiently in HBM on
+# the way back.  Small blocks keep the one-shot einsum (fewer reassociated
+# sums: the x64 oracle tests compare at 1e-12).
+_BWD_TILE_ABOVE = 512
+
+
+def _bwd_tile_math(qf, k_tile, v_tile, do, lse, delta, dlse, q_pos,
+                   kv_pos_tile, causal, scale):
+    """Gradient contributions of one KV tile (shared by the one-shot and
+    tiled paths; flash backward: ds = p * (dp - delta + dlse))."""
+    s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_tile) * scale
+    if causal:
+        mask = (q_pos[:, None] >= kv_pos_tile[None, :])[None, :, None, :]
+        s = jnp.where(mask, s, NEG_BIG)
+    p = jnp.exp(s - lse[..., None])          # = softmax over this block
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    dp = jnp.einsum("bqhd,bkhd->bqhk", do, v_tile)
+    dv = jnp.einsum("bqhk,bqhd->bkhd", p, do)
+    ds = p * (dp - delta[..., None] + dlse[..., None])
+    dq = jnp.einsum("bqhk,bkhd->bqhd", ds, k_tile) * scale
+    dk = jnp.einsum("bqhk,bqhd->bkhd", ds, qf) * scale
+    return dq, dk, dv
+
+
 def _block_bwd(causal, impl, res, cot):
     """Flash-style backward by block recomputation (residuals: out + lse;
-    the score matrix is rebuilt, never stored)."""
+    the score matrix is rebuilt — tiled over KV for large blocks — never
+    stored)."""
     q, k, v, q_off, kv_off, out, lse = res
     do, dlse = cot
     f32 = _compute_dtype(q)
@@ -250,23 +278,32 @@ def _block_bwd(causal, impl, res, cot):
     do = do.astype(f32)
     lse = lse.astype(f32)
     dlse = dlse.astype(f32)
-    s = jnp.einsum("bqhd,bkhd->bqhk", qf, kf) * scale
-    if causal:
-        q_pos = q_off + jnp.arange(sq, dtype=f32)
-        kv_pos = kv_off + jnp.arange(sk, dtype=f32)
-        mask = (q_pos[:, None] >= kv_pos[None, :])[None, :, None, :]
-        s = jnp.where(mask, s, NEG_BIG)
-    p = jnp.exp(s - lse[..., None])          # = softmax over this block
-    if causal:
-        p = jnp.where(mask, p, 0.0)
-    # d p: from out = p @ v  (p already normalized by construction of lse)
-    dp = jnp.einsum("bqhd,bkhd->bqhk", do, vf)
-    dv = jnp.einsum("bqhk,bqhd->bkhd", p, do)
     delta = jnp.sum(do * out.astype(f32), axis=-1)      # (b, q, h)
-    # lse cotangent: d lse/d s = p, and out depends on lse via -p*out term
-    ds = p * (dp - delta[..., None] + dlse[..., None])
-    dq = jnp.einsum("bqhk,bkhd->bqhd", ds, kf) * scale
-    dk = jnp.einsum("bqhk,bqhd->bkhd", ds, qf) * scale
+    q_pos = q_off.astype(f32) + jnp.arange(sq, dtype=f32)
+    kv_pos = kv_off.astype(f32) + jnp.arange(sk, dtype=f32)
+
+    kt = _KV_TILE
+    if sk <= _BWD_TILE_ABOVE or sk % kt != 0:
+        dq, dk, dv = _bwd_tile_math(qf, kf, vf, do, lse, delta, dlse,
+                                    q_pos, kv_pos, causal, scale)
+    else:
+        def body(j, carry):
+            dq, dk, dv = carry
+            k_t = jax.lax.dynamic_slice_in_dim(kf, j * kt, kt, 1)
+            v_t = jax.lax.dynamic_slice_in_dim(vf, j * kt, kt, 1)
+            kv_pos_t = jax.lax.dynamic_slice_in_dim(kv_pos, j * kt, kt, 0)
+            dq_t, dk_t, dv_t = _bwd_tile_math(
+                qf, k_t, v_t, do, lse, delta, dlse, q_pos, kv_pos_t,
+                causal, scale)
+            dq = dq + dq_t
+            dk = jax.lax.dynamic_update_slice_in_dim(dk, dk_t, j * kt, 1)
+            dv = jax.lax.dynamic_update_slice_in_dim(dv, dv_t, j * kt, 1)
+            return dq, dk, dv
+
+        dq, dk, dv = jax.lax.fori_loop(
+            0, sk // kt, body,
+            (jnp.zeros_like(qf), jnp.zeros_like(kf), jnp.zeros_like(vf)))
+
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             jnp.zeros_like(jnp.asarray(q_off, f32)),
             jnp.zeros_like(jnp.asarray(kv_off, f32)))

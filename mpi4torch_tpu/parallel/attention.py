@@ -67,7 +67,7 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
     from ..ops.flash import flash_block_attention, merge_partials
 
     size = comm.size
-    b, s_local, h, d = q.shape
+    s_local = q.shape[1]
 
     # Global block positions: rank may be symbolic (lax.axis_index) under
     # SPMD tracing; all masking is array arithmetic (SURVEY.md §7 hard
