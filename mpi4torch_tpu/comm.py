@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from . import constants as C
@@ -72,6 +73,23 @@ def JoinDummiesHandle(handle: WaitHandle, dummies: Sequence) -> WaitHandle:
 def _spmd_context():
     from .ops import spmd as _spmd
     return _spmd.current_spmd_context()
+
+
+def _named_op(method):
+    """Run a facade op under ``jax.named_scope("mpi4torch.<Name>")`` (the
+    trailing in-place underscore stripped), so profiler traces and lowered
+    programs carry per-op spans — the analogue of the reference's autograd
+    node names being its observability surface (SURVEY.md §5)."""
+    import functools
+
+    scope = "mpi4torch." + method.__name__.rstrip("_")
+
+    @functools.wraps(method)
+    def wrapped(self, *args, **kwargs):
+        with jax.named_scope(scope):
+            return method(self, *args, **kwargs)
+
+    return wrapped
 
 
 class MPI_Communicator:
@@ -141,65 +159,79 @@ class MPI_Communicator:
 
     # ----------------------------------------------------------- collectives
 
+    @_named_op
     def Allreduce(self, tensor, op: int):
         """Element-wise combine across all ranks, result on every rank
         (reference: src/__init__.py:125-152, csrc/extension.cpp:274-308).
         Only ``MPI_SUM`` is differentiable; other ops raise in backward."""
         return self._backend().allreduce(tensor, op)
 
+    @_named_op
     def Bcast_(self, tensor, root: int):
         """Broadcast from ``root`` (reference: src/__init__.py:154-175)."""
         return self._backend().bcast_(tensor, root)
 
+    @_named_op
     def Reduce_(self, tensor, op: int, root: int):
         """Reduce to ``root``; non-root results are zeroed and the input is
         consumed (reference: src/__init__.py:177-210,
         csrc/extension.cpp:405-464)."""
         return self._backend().reduce_(tensor, op, root)
 
+    @_named_op
     def Gather(self, tensor, gatheraxis: int, root: int):
         """Concatenate per-rank tensors along ``gatheraxis`` on ``root``;
         per-rank axis lengths may differ (reference: src/__init__.py:212-213,
         csrc/extension.cpp:497-599)."""
         return self._backend().gather(tensor, gatheraxis, root)
 
+    @_named_op
     def Allgather(self, tensor, gatheraxis: int):
         """Gather with the result on every rank (reference:
         src/__init__.py:215-216, csrc/extension.cpp:633-734)."""
         return self._backend().allgather(tensor, gatheraxis)
 
+    @_named_op
     def Scatter(self, tensor, scatteraxis: int, numelem: int, root: int):
         """Split ``root``'s tensor along ``scatteraxis``; this rank keeps
         ``numelem`` entries.  Non-root input shapes are ignored (reference:
         src/__init__.py:218-219, csrc/extension.cpp:769-884)."""
-        return self._backend().scatter(tensor, scatteraxis, numelem, root)
+        return self._backend().scatter(tensor, scatteraxis, numelem,
+                                       root)
 
+    @_named_op
     def Alltoall(self, tensor, gatheraxis: int, scatteraxis: int, numelem: int):
         """Combined gather/redistribute (reference: src/__init__.py:221-223,
         csrc/extension.cpp:917-987)."""
-        return self._backend().alltoall(tensor, gatheraxis, scatteraxis, numelem)
+        return self._backend().alltoall(tensor, gatheraxis, scatteraxis,
+                                        numelem)
 
     # ------------------------------------------------------------------ p2p
 
+    @_named_op
     def Isend(self, tensor, dest: int, tag: int) -> WaitHandle:
         """Nonblocking send (reference: src/__init__.py:225-226)."""
         return WaitHandle(self._backend().isend(tensor, dest, tag))
 
+    @_named_op
     def Irecv(self, tensor, source: int, tag: int) -> WaitHandle:
         """Nonblocking receive into ``tensor``'s shape (reference:
         src/__init__.py:228-229)."""
         return WaitHandle(self._backend().irecv(tensor, source, tag))
 
+    @_named_op
     def Wait(self, waithandle: WaitHandle):
         """Complete a nonblocking request (reference: src/__init__.py:231-232,
         csrc/extension.cpp:1220-1265)."""
         return self._backend().wait(waithandle._handle)
 
+    @_named_op
     def Send(self, tensor, dest: int, tag: int):
         """Blocking send = Isend + Wait (reference: src/__init__.py:234-236)."""
         b = self._backend()
         return b.wait(b.isend(tensor, dest, tag))
 
+    @_named_op
     def Recv(self, tensor, source: int, tag: int):
         """Blocking receive = Irecv + Wait (reference:
         src/__init__.py:238-240)."""

@@ -256,6 +256,16 @@ def _allreduce_fwd_value(ctx: SpmdContext, x, op: int):
     return _ordered_fold_allreduce(ctx, x, op)
 
 
+
+def _bwd_scope(opname: str):
+    """Named scope for collective adjoints so profiler traces show explicit
+    *Backward spans — the reference's only observability surface is its
+    autograd node names (SURVEY.md §5 tracing; e.g. MPIAllreduceSumBackward,
+    csrc/extension.cpp:256-258).  The p2p trio is not covered: its reverse
+    ring is XLA's built-in transpose of the matched ppermute, which carries
+    the forward scope's transpose metadata rather than a dedicated span."""
+    return jax.named_scope(f"mpi4torch.{opname}Backward")
+
 def allreduce(ctx: SpmdContext, x, op: int):
     """SPMD Allreduce (reference: csrc/extension.cpp:274-308).  SUM lowers
     to ``lax.psum`` (self-adjoint); other ops' backward raises, matching
@@ -272,7 +282,8 @@ def allreduce(ctx: SpmdContext, x, op: int):
                 "implemented — only MPI_SUM is differentiable (reference: "
                 "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
             )
-        return (_allreduce_fwd_value(ctx, g, C.MPI_SUM),)
+        with _bwd_scope("Allreduce"):
+            return (_allreduce_fwd_value(ctx, g, C.MPI_SUM),)
 
     f.defvjp(lambda v: (_allreduce_fwd_value(ctx, v, op), None), bwd)
     return f(x)
@@ -304,8 +315,11 @@ def bcast_(ctx: SpmdContext, x, root: int):
     def f(v):
         return _bcast_value(ctx, v, root)
 
-    f.defvjp(lambda v: (_bcast_value(ctx, v, root), None),
-             lambda _, g: (_reduce_value(ctx, g, C.MPI_SUM, root),))
+    def bwd(_, g):
+        with _bwd_scope("Bcast"):
+            return (_reduce_value(ctx, g, C.MPI_SUM, root),)
+
+    f.defvjp(lambda v: (_bcast_value(ctx, v, root), None), bwd)
     return f(x)
 
 
@@ -326,7 +340,8 @@ def reduce_(ctx: SpmdContext, x, op: int, root: int):
                 "implemented — only MPI_SUM is differentiable (reference: "
                 "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
             )
-        return (_bcast_value(ctx, g, root),)
+        with _bwd_scope("Reduce"):
+            return (_bcast_value(ctx, g, root),)
 
     f.defvjp(lambda v: (_reduce_value(ctx, v, op, root), None), bwd)
     return f(x)
@@ -348,8 +363,9 @@ def allgather(ctx: SpmdContext, x, gatheraxis: int):
         return lax.all_gather(v, ctx.axis_name, axis=ax, tiled=True)
 
     def bwd(_, g):
-        return (lax.psum_scatter(g, ctx.axis_name, scatter_dimension=ax,
-                                 tiled=True),)
+        with _bwd_scope("Allgather"):
+            return (lax.psum_scatter(g, ctx.axis_name, scatter_dimension=ax,
+                                     tiled=True),)
 
     f.defvjp(lambda v: (lax.all_gather(v, ctx.axis_name, axis=ax, tiled=True),
                         None), bwd)
@@ -378,8 +394,10 @@ def gather(ctx: SpmdContext, x, gatheraxis: int, root: int):
         # outputs are zeros); one root-masked psum_scatter delivers each
         # rank its segment of it — Scatter(grad, ax, numelem, root),
         # csrc/extension.cpp:466-495.
-        return (lax.psum_scatter(_mask_to_root(ctx, g, root), ctx.axis_name,
-                                 scatter_dimension=ax, tiled=True),)
+        with _bwd_scope("Gather"):
+            return (lax.psum_scatter(_mask_to_root(ctx, g, root),
+                                     ctx.axis_name, scatter_dimension=ax,
+                                     tiled=True),)
 
     f.defvjp(lambda v: (fwd_value(v), None), bwd)
     return f(x)
@@ -417,12 +435,13 @@ def scatter(ctx: SpmdContext, x, scatteraxis: int, numelem: int, root: int):
         return fwd_value(v)
 
     def bwd(_, g):
-        full = lax.all_gather(g, ctx.axis_name, axis=ax, tiled=True)
-        # Gradient is real only on root (non-root inputs were ignored);
-        # keep the collective in every rank's program (the moral of the
-        # reference's JoinDummies(zeros, {gather}) trick,
-        # csrc/extension.cpp:756-766) and mask.
-        return (_mask_to_root(ctx, full, root),)
+        with _bwd_scope("Scatter"):
+            full = lax.all_gather(g, ctx.axis_name, axis=ax, tiled=True)
+            # Gradient is real only on root (non-root inputs were ignored);
+            # keep the collective in every rank's program (the moral of the
+            # reference's JoinDummies(zeros, {gather}) trick,
+            # csrc/extension.cpp:756-766) and mask.
+            return (_mask_to_root(ctx, full, root),)
 
     f.defvjp(lambda v: (fwd_value(v), None), bwd)
     return f(x)
@@ -451,8 +470,9 @@ def alltoall(ctx: SpmdContext, x, gatheraxis: int, scatteraxis: int,
                               concat_axis=ga, tiled=True)
 
     def bwd(_, g):
-        return (lax.all_to_all(g, ctx.axis_name, split_axis=ga,
-                               concat_axis=sa, tiled=True),)
+        with _bwd_scope("Alltoall"):
+            return (lax.all_to_all(g, ctx.axis_name, split_axis=ga,
+                                   concat_axis=sa, tiled=True),)
 
     f.defvjp(lambda v: (lax.all_to_all(v, ctx.axis_name, split_axis=sa,
                                        concat_axis=ga, tiled=True), None),
