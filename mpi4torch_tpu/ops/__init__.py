@@ -13,5 +13,13 @@ ring, with a jnp fallback for ineligible shapes/platforms.
 """
 
 from .flash import flash_attention, flash_block_attention, merge_partials
+from .ragged import ragged_allgather, ragged_alltoall, segment_mask
 
-__all__ = ["flash_attention", "flash_block_attention", "merge_partials"]
+__all__ = [
+    "flash_attention",
+    "flash_block_attention",
+    "merge_partials",
+    "ragged_allgather",
+    "ragged_alltoall",
+    "segment_mask",
+]

@@ -1,0 +1,104 @@
+"""Ragged (per-rank-varying) collectives under static shapes.
+
+The reference's Gather/Scatter/Alltoall accept *per-rank-varying* segment
+sizes, realized with MPI_Gatherv-style derived datatypes
+(reference: csrc/extension.cpp:540-554, 947-979).  Under single-trace SPMD
+every rank runs one XLA program with static shapes, so varying sizes are
+expressed the XLA way instead (SURVEY.md §7 hard part 2): **capacity-padded
+buffers + validity counts + masks**.  These ops carry exactly the
+information of their MPI_*v counterparts — (payload, counts) in,
+(payload, counts) out — and work identically on both backends, since they
+are built purely on the facade's dense collectives (hence AD-transparent:
+cotangents route back through the same exchange, and padding slots never
+receive or leak gradient).
+
+The eager runtime additionally supports the reference's *true* varying
+sizes on the dense ops themselves (shapes are per-rank concrete there);
+these ragged forms are the portable recipe that also compiles.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def segment_mask(counts, capacity: int):
+    """``(...,)`` (or scalar) int counts → ``(..., capacity)`` validity
+    mask of 0/1 int32 (a scalar count yields a ``(capacity,)`` mask)."""
+    pos = jnp.arange(capacity)
+    return (pos < jnp.asarray(counts)[..., None]).astype(jnp.int32)
+
+
+def _masked(x, counts, capacity: int):
+    m = segment_mask(counts, capacity)
+    return x * m.reshape(m.shape + (1,) * (x.ndim - m.ndim)).astype(x.dtype)
+
+
+def ragged_alltoall(comm, x, send_counts) -> Tuple:
+    """All-to-all with per-destination-varying segment sizes (the
+    MPI_Alltoallv analogue; reference's same-axis Alltoall with varying
+    ``numelem``, csrc/extension.cpp:947-979).
+
+    ``x``: ``(size, capacity, *feat)`` — row block ``i`` is destined for
+    rank ``i``, of which the first ``send_counts[i]`` entries are valid.
+    ``send_counts``: ``(size,)`` integers, each ``<= capacity``.
+
+    Returns ``(recv, recv_counts)``: ``recv[s]`` is the block rank ``s``
+    sent here (``(size, capacity, *feat)``), with invalid slots zeroed;
+    ``recv_counts[s]`` its valid length.  Differentiable in ``x``; padding
+    slots get zero gradient (they are masked before the exchange, so the
+    adjoint exchange routes nothing into them)."""
+    size = comm.size
+    if x.ndim < 2 or x.shape[0] != size:
+        raise ValueError(
+            f"ragged_alltoall expects x of shape (size={size}, capacity, "
+            f"*feat); got {x.shape}")
+    capacity = x.shape[1]
+    send_counts = jnp.asarray(send_counts)
+    if send_counts.shape != (size,):
+        raise ValueError(
+            f"send_counts must have shape ({size},); got {send_counts.shape}")
+    # Clamp so the transmitted counts can never exceed what the mask lets
+    # through — an over-capacity count would otherwise arrive as a
+    # recv_count larger than the actual zero-padded valid data.
+    send_counts = jnp.minimum(send_counts, capacity)
+
+    xz = _masked(x, send_counts, capacity)
+    # Gather sources along a fresh axis, keep my destination block:
+    # (size, cap, *feat) -> my (1, size*cap, *feat), source-major.
+    recv = comm.Alltoall(xz, gatheraxis=1, scatteraxis=0, numelem=1)
+    recv = recv.reshape((size, capacity) + x.shape[2:])
+    rc = comm.Alltoall(send_counts.reshape(size, 1), gatheraxis=1,
+                       scatteraxis=0, numelem=1)
+    return recv, rc.reshape(size)
+
+
+def ragged_allgather(comm, x, count) -> Tuple:
+    """Allgather with per-rank-varying valid lengths (the MPI_Allgatherv
+    analogue; reference: csrc/extension.cpp:633-734 with varying shard
+    sizes).
+
+    ``x``: ``(capacity, *feat)`` with the first ``count`` rows valid.
+    Returns ``(gathered, counts)``: ``gathered`` is ``(size, capacity,
+    *feat)`` — rank ``s``'s padded block at index ``s``, invalid slots
+    zeroed — and ``counts`` is ``(size,)``.  ``jnp.concatenate`` of the
+    per-rank valid prefixes reconstructs the reference's exact Allgatherv
+    result (see tests)."""
+    if x.ndim < 1:
+        raise ValueError(
+            f"ragged_allgather expects x of shape (capacity, *feat); got "
+            f"{x.shape}")
+    capacity = x.shape[0]
+    count = jnp.asarray(count)
+    if count.ndim != 0:
+        raise ValueError(
+            f"count must be a scalar (this rank's valid length); got shape "
+            f"{count.shape} — per-destination counts belong to "
+            "ragged_alltoall")
+    count = jnp.minimum(count, capacity)
+    xz = _masked(x, count, capacity)
+    gathered = comm.Allgather(xz[None], gatheraxis=0)
+    counts = comm.Allgather(count[None], gatheraxis=0)
+    return gathered, counts

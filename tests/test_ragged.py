@@ -1,0 +1,202 @@
+"""Ragged collectives: capacity-padded + masked per-rank-varying exchange
+(SURVEY.md §7 hard part 2 — the SPMD-compatible form of the reference's
+Gatherv/Alltoallv semantics, csrc/extension.cpp:540-554, 947-979).
+
+Oracles: explicit routing tables built in numpy; identical results on the
+eager and SPMD backends; gradients route back through the exchange with
+zero gradient into padding slots."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import COMM_WORLD as comm
+from mpi4torch_tpu.ops import ragged_allgather, ragged_alltoall, segment_mask
+
+NR = 4
+CAP = 5
+FEAT = 3
+
+# counts[src][dst] = rows src sends to dst (varying, some zero).
+COUNTS = np.array([[1, 2, 0, 3],
+                   [4, 0, 1, 2],
+                   [0, 5, 2, 1],
+                   [2, 1, 3, 0]])
+
+
+def payload(src):
+    """Deterministic payload: row r of src's block for dst carries value
+    100*src + 10*dst + r in every feature slot."""
+    x = np.zeros((NR, CAP, FEAT))
+    for dst in range(NR):
+        for r in range(COUNTS[src][dst]):
+            x[dst, r, :] = 100 * src + 10 * dst + r
+    # Poison the padding so masking is actually load-bearing.
+    for dst in range(NR):
+        x[dst, COUNTS[src][dst]:, :] = -999.0
+    return jnp.asarray(x)
+
+
+def expected_recv(dst):
+    r = np.zeros((NR, CAP, FEAT))
+    for src in range(NR):
+        for row in range(COUNTS[src][dst]):
+            r[src, row, :] = 100 * src + 10 * dst + row
+    return r
+
+
+class TestRaggedAlltoall:
+    def run_backend(self, runner):
+        def body():
+            rk = comm.rank
+            x = jnp.stack([payload(s) for s in range(NR)])[rk] \
+                if not isinstance(rk, int) else payload(rk)
+            cnt = jnp.asarray(COUNTS)[rk]
+            recv, rc = ragged_alltoall(comm, x, cnt)
+            return recv, rc
+        return runner(body)
+
+    def test_eager_matches_routing_oracle(self):
+        outs = mpi.run_ranks(
+            lambda: jax.tree.map(np.asarray, self.run_backend(lambda b: b())),
+            NR)
+        for dst, (recv, rc) in enumerate(outs):
+            np.testing.assert_array_equal(recv, expected_recv(dst))
+            np.testing.assert_array_equal(rc, COUNTS[:, dst])
+
+    def test_spmd_matches_eager(self):
+        def body():
+            rk = jnp.asarray(comm.rank)
+            x = jnp.stack([payload(s) for s in range(NR)])[rk]
+            cnt = jnp.asarray(COUNTS)[rk]
+            return ragged_alltoall(comm, x, cnt)
+
+        recv, rc = mpi.run_spmd(body, nranks=NR)()
+        for dst in range(NR):
+            np.testing.assert_array_equal(np.asarray(recv)[dst],
+                                          expected_recv(dst))
+            np.testing.assert_array_equal(np.asarray(rc)[dst],
+                                          COUNTS[:, dst])
+
+    def test_grads_route_back_and_padding_gets_zero(self):
+        def body():
+            r = int(comm.rank)
+            x = payload(r)
+            cnt = jnp.asarray(COUNTS)[r]
+
+            def loss(x):
+                recv, _ = ragged_alltoall(comm, x, cnt)
+                return jnp.sum(recv)
+
+            return np.asarray(jax.grad(loss)(x))
+
+        grads = mpi.run_ranks(body, NR)
+        for src, g in enumerate(grads):
+            mask = np.zeros((NR, CAP, FEAT))
+            for dst in range(NR):
+                mask[dst, :COUNTS[src][dst], :] = 1.0
+            # Valid slots got cotangent 1 (delivered across ranks); the
+            # poisoned padding slots got exactly zero.
+            np.testing.assert_array_equal(g, mask)
+
+    def test_shape_validation(self):
+        def body():
+            with pytest.raises(ValueError, match="capacity"):
+                ragged_alltoall(comm, jnp.zeros((2, CAP, 1)),
+                                jnp.zeros((NR,), jnp.int32))
+            with pytest.raises(ValueError, match="send_counts"):
+                ragged_alltoall(comm, jnp.zeros((NR, CAP, 1)),
+                                jnp.zeros((2,), jnp.int32))
+            return True
+
+        assert all(mpi.run_ranks(body, NR))
+
+
+class TestRaggedAllgather:
+    def test_reconstructs_allgatherv(self):
+        lens = [2, 5, 1, 3]
+
+        def body():
+            r = int(comm.rank)
+            x = np.full((CAP, FEAT), -999.0)
+            x[:lens[r]] = 10 * r + np.arange(lens[r])[:, None]
+            g, c = ragged_allgather(comm, jnp.asarray(x), lens[r])
+            return np.asarray(g), np.asarray(c)
+
+        outs = mpi.run_ranks(body, NR)
+        want = np.concatenate([
+            (10 * r + np.arange(lens[r])[:, None]) * np.ones((1, FEAT))
+            for r in range(NR)])
+        for g, c in outs:
+            np.testing.assert_array_equal(c, lens)
+            got = np.concatenate([g[r, :lens[r]] for r in range(NR)])
+            np.testing.assert_array_equal(got, want)
+
+    def test_spmd_backend(self):
+        lens = jnp.asarray([2, 5, 1, 3])
+
+        def body():
+            r = jnp.asarray(comm.rank)
+            base = jnp.arange(CAP, dtype=jnp.float64)[:, None] + 10.0 * r
+            x = jnp.broadcast_to(base, (CAP, FEAT))
+            return ragged_allgather(comm, x, lens[r])
+
+        g, c = mpi.run_spmd(body, nranks=NR)()
+        g, c = np.asarray(g), np.asarray(c)
+        for dst in range(NR):
+            np.testing.assert_array_equal(c[dst], [2, 5, 1, 3])
+            for src in range(NR):
+                valid = g[dst, src, :int(c[dst][src])]
+                expect = (10.0 * src
+                          + np.arange(int(c[dst][src]))[:, None]
+                          ) * np.ones((1, FEAT))
+                np.testing.assert_array_equal(valid, expect)
+                np.testing.assert_array_equal(
+                    g[dst, src, int(c[dst][src]):], 0.0)
+
+
+class TestSegmentMask:
+    def test_mask_shape_and_values(self):
+        m = np.asarray(segment_mask(jnp.asarray([0, 2, 5]), 5))
+        np.testing.assert_array_equal(m[0], np.zeros(5))
+        np.testing.assert_array_equal(m[1], [1, 1, 0, 0, 0])
+        np.testing.assert_array_equal(m[2], np.ones(5))
+
+    def test_scalar_count_gives_1d_mask(self):
+        m = np.asarray(segment_mask(jnp.asarray(3), 5))
+        assert m.shape == (5,)
+        np.testing.assert_array_equal(m, [1, 1, 1, 0, 0])
+
+
+class TestRobustness:
+    def test_over_capacity_counts_are_clamped(self):
+        # A count > capacity must not transmit a recv_count larger than
+        # the actual zero-padded valid data.
+        def body():
+            r = int(comm.rank)
+            x = jnp.ones((NR, CAP, FEAT))
+            cnt = jnp.full((NR,), CAP + 3)
+            recv, rc = ragged_alltoall(comm, x, cnt)
+            return np.asarray(rc)
+
+        for rc in mpi.run_ranks(body, NR):
+            np.testing.assert_array_equal(rc, np.full(NR, CAP))
+
+    def test_allgather_rejects_vector_count(self):
+        def body():
+            with pytest.raises(ValueError, match="scalar"):
+                ragged_allgather(comm, jnp.zeros((CAP, FEAT)),
+                                 jnp.zeros((NR,), jnp.int32))
+            return True
+
+        assert all(mpi.run_ranks(body, NR))
+
+    def test_allgather_clamps_count(self):
+        def body():
+            g, c = ragged_allgather(comm, jnp.ones((CAP, FEAT)), CAP + 9)
+            return np.asarray(c)
+
+        for c in mpi.run_ranks(body, NR):
+            np.testing.assert_array_equal(c, np.full(NR, CAP))
