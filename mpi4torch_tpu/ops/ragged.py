@@ -33,7 +33,10 @@ def segment_mask(counts, capacity: int):
 
 def _masked(x, counts, capacity: int):
     m = segment_mask(counts, capacity)
-    return x * m.reshape(m.shape + (1,) * (x.ndim - m.ndim)).astype(x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - m.ndim))
+    # where, not multiply: padding slots may hold NaN/inf (e.g. leftovers
+    # of a masked softmax) and NaN*0 would survive as NaN.
+    return jnp.where(m != 0, x, jnp.zeros((), x.dtype))
 
 
 def ragged_alltoall(comm, x, send_counts) -> Tuple:
@@ -60,10 +63,10 @@ def ragged_alltoall(comm, x, send_counts) -> Tuple:
     if send_counts.shape != (size,):
         raise ValueError(
             f"send_counts must have shape ({size},); got {send_counts.shape}")
-    # Clamp so the transmitted counts can never exceed what the mask lets
-    # through — an over-capacity count would otherwise arrive as a
-    # recv_count larger than the actual zero-padded valid data.
-    send_counts = jnp.minimum(send_counts, capacity)
+    # Clamp to [0, capacity] so the transmitted counts always agree with
+    # what the mask lets through — an out-of-range count would otherwise
+    # arrive as a recv_count inconsistent with the zero-padded valid data.
+    send_counts = jnp.clip(send_counts, 0, capacity)
 
     xz = _masked(x, send_counts, capacity)
     # Gather sources along a fresh axis, keep my destination block:
@@ -97,7 +100,7 @@ def ragged_allgather(comm, x, count) -> Tuple:
             f"count must be a scalar (this rank's valid length); got shape "
             f"{count.shape} — per-destination counts belong to "
             "ragged_alltoall")
-    count = jnp.minimum(count, capacity)
+    count = jnp.clip(count, 0, capacity)
     xz = _masked(x, count, capacity)
     gathered = comm.Allgather(xz[None], gatheraxis=0)
     counts = comm.Allgather(count[None], gatheraxis=0)

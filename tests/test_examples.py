@@ -80,6 +80,26 @@ class TestHaloExchangeStencil:
         np.testing.assert_allclose(u4, u1, atol=1e-8)
 
 
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_ring_attention_longcontext(attn):
+    # SURVEY.md §2.5 SP/CP demo: sharded attention == dense oracle over
+    # the full context, values and gradients, on 4 ranks.
+    mod = _load("ring_attention_longcontext")
+    nranks, spr = 4, 8
+    q, k, v = mod.make_qkv(nranks * spr)
+    import jax
+    import jax.numpy as jnp
+    ref_out = mod.dense_attention(q, k, v, causal=True)
+    ref_dq = jax.grad(lambda q: jnp.sum(
+        mod.dense_attention(q, k, v, causal=True) ** 2))(q)
+    results = mpi.run_ranks(lambda: mod.main(spr, attn), nranks)
+    out = np.concatenate([o for o, _ in results], axis=1)
+    dq = np.concatenate([g for _, g in results], axis=1)
+    np.testing.assert_allclose(out, np.asarray(ref_out), rtol=1e-9,
+                               atol=1e-11)
+    np.testing.assert_allclose(dq, np.asarray(ref_dq), rtol=1e-9, atol=1e-11)
+
+
 @pytest.mark.parametrize("nranks", [2, 5])
 def test_isend_recv_wait(nranks):
     mod = _load("isend_recv_wait")

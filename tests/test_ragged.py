@@ -48,20 +48,14 @@ def expected_recv(dst):
 
 
 class TestRaggedAlltoall:
-    def run_backend(self, runner):
-        def body():
-            rk = comm.rank
-            x = jnp.stack([payload(s) for s in range(NR)])[rk] \
-                if not isinstance(rk, int) else payload(rk)
-            cnt = jnp.asarray(COUNTS)[rk]
-            recv, rc = ragged_alltoall(comm, x, cnt)
-            return recv, rc
-        return runner(body)
-
     def test_eager_matches_routing_oracle(self):
-        outs = mpi.run_ranks(
-            lambda: jax.tree.map(np.asarray, self.run_backend(lambda b: b())),
-            NR)
+        def body():
+            r = int(comm.rank)
+            recv, rc = ragged_alltoall(comm, payload(r),
+                                       jnp.asarray(COUNTS)[r])
+            return np.asarray(recv), np.asarray(rc)
+
+        outs = mpi.run_ranks(body, NR)
         for dst, (recv, rc) in enumerate(outs):
             np.testing.assert_array_equal(recv, expected_recv(dst))
             np.testing.assert_array_equal(rc, COUNTS[:, dst])
@@ -192,6 +186,32 @@ class TestRobustness:
             return True
 
         assert all(mpi.run_ranks(body, NR))
+
+    def test_nan_padding_does_not_leak(self):
+        # Padding may hold NaN (e.g. masked-softmax leftovers); the
+        # exchange must still deliver zeros in invalid slots.
+        def body():
+            r = int(comm.rank)
+            x = jnp.where(jnp.isnan(jnp.full((NR, CAP, FEAT), jnp.nan)),
+                          jnp.nan, 0.0)
+            x = x.at[:, 0].set(1.0)
+            recv, rc = ragged_alltoall(comm, x, jnp.ones((NR,), jnp.int32))
+            return np.asarray(recv)
+
+        for recv in mpi.run_ranks(body, NR):
+            assert np.all(np.isfinite(recv))
+            np.testing.assert_array_equal(recv[:, 0], 1.0)
+            np.testing.assert_array_equal(recv[:, 1:], 0.0)
+
+    def test_negative_counts_clamped_to_zero(self):
+        def body():
+            x = jnp.ones((NR, CAP, FEAT))
+            recv, rc = ragged_alltoall(comm, x, jnp.full((NR,), -2))
+            return np.asarray(rc), np.asarray(recv)
+
+        for rc, recv in mpi.run_ranks(body, NR):
+            np.testing.assert_array_equal(rc, 0)
+            np.testing.assert_array_equal(recv, 0.0)
 
     def test_allgather_clamps_count(self):
         def body():
