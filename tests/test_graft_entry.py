@@ -22,7 +22,14 @@ def test_entry_compiles_and_runs():
 
 
 def test_dryrun_multichip_8():
+    # 8 devices: the 3D dp x sp x ep mesh (MoE transformer; DP + ring
+    # attention + expert dispatch in one program).
     graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_4():
+    # Non-multiple-of-8: the 2D dp x sp dense-FFN fallback.
+    graft.dryrun_multichip(4)
 
 
 def test_bench_json_line():
