@@ -1,0 +1,89 @@
+"""mpi4torch_tpu — AD-transparent collective communication, TPU-native.
+
+A brand-new JAX/XLA framework with the capabilities of mpi4torch
+(helmholtz-analytics/mpi4torch): every communication op — Allreduce, Bcast_,
+Reduce_, Gather, Allgather, Scatter, Alltoall, Send/Recv, Isend/Irecv/Wait —
+is differentiable, with the backward pass being the *adjoint* communication
+op, plus the JoinDummies/WaitHandle dependency-token machinery
+(reference: README.md:5-10, src/__init__.py:5-25).
+
+Two interchangeable backends behind one facade:
+
+* eager thread-SPMD (:func:`run_ranks`) — the ``mpirun -np N`` analogue with
+  concrete per-rank ranks/shapes; semantics/parity path and deterministic
+  bit-exact oracle.
+* SPMD mesh (:func:`run_spmd`, ``comm_from_mesh``) — single-trace ``shard_map``
+  over a :class:`jax.sharding.Mesh`, lowering to XLA collectives over
+  ICI/DCN; the TPU performance path.
+"""
+
+from .constants import (
+    MPI_MAX,
+    MPI_MIN,
+    MPI_SUM,
+    MPI_PROD,
+    MPI_LAND,
+    MPI_BAND,
+    MPI_LOR,
+    MPI_BOR,
+    MPI_LXOR,
+    MPI_BXOR,
+    MPI_MINLOC,
+    MPI_MAXLOC,
+)
+from .comm import (
+    COMM_WORLD,
+    JoinDummies,
+    JoinDummiesHandle,
+    MPI_Communicator,
+    WaitHandle,
+    comm_from_mesh,
+    comm_from_mpi4py,
+    deactivate_cuda_aware_mpi_support,
+)
+from .runtime import (
+    BifurcationError,
+    CollectiveMismatchError,
+    CommError,
+    DeadlockError,
+    InPlaceReuseError,
+    run_ranks,
+)
+from .ops.spmd import RankExpr, run_spmd
+from . import config
+
+__all__ = [
+    # reference __all__ (src/__init__.py:5-25)
+    "MPI_MAX",
+    "MPI_MIN",
+    "MPI_SUM",
+    "MPI_PROD",
+    "MPI_LAND",
+    "MPI_BAND",
+    "MPI_LOR",
+    "MPI_BOR",
+    "MPI_LXOR",
+    "MPI_BXOR",
+    "MPI_MINLOC",
+    "MPI_MAXLOC",
+    "WaitHandle",
+    "JoinDummies",
+    "JoinDummiesHandle",
+    "MPI_Communicator",
+    "COMM_WORLD",
+    "comm_from_mpi4py",
+    "deactivate_cuda_aware_mpi_support",
+    # TPU-native additions
+    "comm_from_mesh",
+    "run_ranks",
+    "run_spmd",
+    "RankExpr",
+    "config",
+    "CommError",
+    "CollectiveMismatchError",
+    "DeadlockError",
+    "InPlaceReuseError",
+    "BifurcationError",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,132 @@
+"""ctypes loader for the native runtime kernels (see native.cc).
+
+Builds the shared library on first import if a toolchain is present (the
+analogue of the reference's compile-on-install, reference: setup.py:60-107);
+every entry point has a pure-Python fallback, so absence of g++ degrades
+performance, never correctness.  Set ``MPI4TORCH_TPU_NO_NATIVE=1`` to force
+the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+import numpy as np
+
+from .. import constants as _C
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libmpi4torch_tpu_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    try:
+        return any(
+            os.path.getmtime(os.path.join(_HERE, src)) > so_mtime
+            for src in ("native.cc", "Makefile"))
+    except OSError:
+        return False  # source-less install (prebuilt .so only): use it
+
+
+def _build() -> bool:
+    # Rebuild only when native.cc/Makefile are newer than the .so (a stale
+    # prebuilt binary must not keep running old kernels after a source fix,
+    # and a fresh one must not pay a make subprocess on every import).
+    if not _stale():
+        return True
+    try:
+        subprocess.run(["make", "-C", _HERE], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except OSError:
+        return os.path.exists(_SO)  # no toolchain: use an existing build
+    except subprocess.SubprocessError:
+        return False  # build FAILED: never load a stale binary silently
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MPI4TORCH_TPU_NO_NATIVE") == "1":
+        return None
+    if not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.fnv1a32.restype = ctypes.c_uint32
+    lib.fnv1a32.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    for name in ("ordered_reduce_f32", "ordered_reduce_f64",
+                 "ordered_reduce_i32", "ordered_reduce_i64"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+                       ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p]
+    return lib
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def fnv1a32(data: bytes) -> int:
+    """32-bit FNV-1a, masked to 31 bits (the descriptor fingerprint;
+    analogue of reference csrc/extension.cpp:1100)."""
+    if _lib is not None:
+        return int(_lib.fnv1a32(data, len(data)))
+    h = 0x811C9DC5
+    for ch in data:
+        h ^= ch
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+_REDUCE_FNS = {
+    np.dtype(np.float32): "ordered_reduce_f32",
+    np.dtype(np.float64): "ordered_reduce_f64",
+    np.dtype(np.int32): "ordered_reduce_i32",
+    np.dtype(np.int64): "ordered_reduce_i64",
+}
+
+# Ops the arithmetic kernels support for float dtypes (bitwise/logical ops
+# are integer-only in the native layer, like the reference's MPI dtype
+# table restricts op/dtype combinations, csrc/extension.cpp:106-129).
+_FLOAT_OPS = {_C.MPI_MAX, _C.MPI_MIN, _C.MPI_SUM, _C.MPI_PROD}
+_INT_OPS = _FLOAT_OPS | {_C.MPI_LAND, _C.MPI_BAND, _C.MPI_LOR, _C.MPI_BOR,
+                         _C.MPI_LXOR, _C.MPI_BXOR}
+
+
+def ordered_reduce(arrays: List[np.ndarray], op: int) -> Optional[np.ndarray]:
+    """Fused ascending-rank-order elementwise reduction over per-rank
+    buffers; bit-identical to the sequential rank-order fold.  Returns None
+    when the native library or the dtype/op combination is unavailable —
+    the caller falls back to the pure-JAX fold."""
+    if _lib is None or len(arrays) == 0:
+        return None
+    a0 = arrays[0]
+    dt = a0.dtype
+    fname = _REDUCE_FNS.get(dt)
+    if fname is None:
+        return None
+    ok_ops = _FLOAT_OPS if dt.kind == "f" else _INT_OPS
+    if op not in ok_ops:
+        return None
+    bufs = [np.ascontiguousarray(a) for a in arrays]
+    if any(b.shape != a0.shape or b.dtype != dt for b in bufs):
+        return None
+    out = np.empty_like(bufs[0])
+    ptrs = (ctypes.c_void_p * len(bufs))(
+        *[b.ctypes.data_as(ctypes.c_void_p).value for b in bufs])
+    getattr(_lib, fname)(ptrs, len(bufs), a0.size, op,
+                         out.ctypes.data_as(ctypes.c_void_p))
+    return out

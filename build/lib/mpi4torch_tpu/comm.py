@@ -1,0 +1,310 @@
+"""User-facing communicator facade.
+
+Mirrors the reference's Python API layer (reference: src/__init__.py:89-245):
+``MPI_Communicator`` with the full op-method surface, the ``COMM_WORLD``
+singleton, and ``WaitHandle``.  The same facade dispatches to one of two
+backends:
+
+* **eager thread-SPMD** (Mode B, :mod:`mpi4torch_tpu.runtime`): inside
+  :func:`mpi4torch_tpu.run_ranks` each rank-thread sees a concrete Python-int
+  ``rank`` — the analogue of an MPI process under ``mpirun``.
+* **SPMD mesh** (Mode A, :mod:`mpi4torch_tpu.ops.spmd`): inside
+  ``run_spmd``/``shard_map`` over a named mesh axis, ops lower to XLA
+  collectives over ICI/DCN and ``rank`` is ``lax.axis_index``.
+
+Outside both, ``COMM_WORLD`` is a single-rank world (size 1), exactly like
+running an MPI binary without ``mpirun``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import constants as C
+from .ops import eager as _eager
+from .runtime import RankContext, current_rank_context, effective_rank_context
+
+
+class WaitHandle:
+    """A wait handle, as returned by the non-blocking communication calls.
+
+    Wraps the raw 3-tensor handle ``[descriptor, buffer, loopthrough]``
+    (reference: src/__init__.py:27-40; descriptor layout
+    csrc/extension.cpp:1094-1107)."""
+
+    def __init__(self, raw_handle: List):
+        self._handle = list(raw_handle)
+
+    @property
+    def dummy(self):
+        """A dummy variable for use as one of the second arguments of
+        :func:`JoinDummies` / :func:`JoinDummiesHandle`
+        (reference: src/__init__.py:34-40)."""
+        return self._handle[0]
+
+
+def JoinDummies(loopthrough, dummies: Sequence):
+    """Join dummy dependencies into the AD graph (reference:
+    src/__init__.py:42-67, csrc/extension.cpp:989-1046).
+
+    Forward is (almost) a no-op returning ``loopthrough``; the ``dummies``
+    are tied in via an XLA optimization barrier so the communication that
+    produced them can be neither reordered nor dead-code-eliminated, and in
+    the backward pass each dummy receives a zero gradient that still carries
+    the dependency chain."""
+    ctx = current_rank_context()
+    if ctx is not None or _spmd_context() is None:
+        return _eager.join_dummies(loopthrough, dummies)
+    from .ops import spmd as _spmd
+    return _spmd.join_dummies(loopthrough, dummies)
+
+
+def JoinDummiesHandle(handle: WaitHandle, dummies: Sequence) -> WaitHandle:
+    """Like :func:`JoinDummies` but for :class:`WaitHandle` (reference:
+    src/__init__.py:69-87): the dummies are joined onto the descriptor slot
+    only."""
+    raw = handle._handle
+    return WaitHandle([JoinDummies(raw[0], dummies), raw[1], raw[2]])
+
+
+def _spmd_context():
+    from .ops import spmd as _spmd
+    return _spmd.current_spmd_context()
+
+
+class MPI_Communicator:
+    """Communicator wrapper (reference: src/__init__.py:89-240).
+
+    Construct via :data:`COMM_WORLD`, :func:`comm_from_mesh`, or
+    :func:`comm_from_mpi4py`.  Methods with an underscore suffix are
+    in-place operations in the reference; here they are functionally pure
+    but keep the names and observable semantics (returned tensor, zeroed
+    non-root results, reuse guard)."""
+
+    def __init__(self, backend_resolver=None):
+        self._resolver = backend_resolver
+
+    # ------------------------------------------------------------- pickling
+
+    def __reduce__(self):
+        """Serialization, world-only (reference: csrc/extension.cpp:1283-1297
+        ``def_pickle``).
+
+        The reference serializes only ``MPI_COMM_WORLD`` — and its
+        deserializer's condition is inverted, throwing precisely on the
+        valid string it wrote (SURVEY.md §2.1, the documented latent bug).
+        This build keeps the world-only restriction (a mesh-axis
+        communicator captures live device objects that have no stable
+        serialized identity) but with working semantics: the round trip
+        restores the :data:`COMM_WORLD` singleton, which re-resolves its
+        backend in the deserializing process."""
+        if self._resolver is None:
+            return (_restore_comm_world, ())
+        import pickle
+        raise pickle.PicklingError(
+            "Unsupported communicator for serialization: only COMM_WORLD "
+            "can be pickled (mesh-derived communicators hold live device "
+            "references; rebuild them with comm_from_mesh after loading)")
+
+    def __copy__(self):
+        # Handle semantics: a communicator denotes a process group, it is
+        # not data — copying a structure that contains one (train-state
+        # pytrees, configs) must hand back the same handle, for every
+        # communicator kind, decoupled from the world-only pickle rule.
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+    # -------------------------------------------------------------- backend
+
+    def _backend(self):
+        if self._resolver is not None:
+            return self._resolver()
+        return _default_resolver()
+
+    @property
+    def rank(self) -> int:
+        """Rank of the local process within this communicator (reference:
+        src/__init__.py:104-111).  A Python int in the eager runtime; a
+        symbolic rank (materializing to ``lax.axis_index``) under SPMD
+        tracing."""
+        return self._backend().rank
+
+    @property
+    def size(self) -> int:
+        """Number of processes in the communicator (reference:
+        src/__init__.py:113-116)."""
+        return self._backend().size
+
+    # ----------------------------------------------------------- collectives
+
+    def Allreduce(self, tensor, op: int):
+        """Element-wise combine across all ranks, result on every rank
+        (reference: src/__init__.py:125-152, csrc/extension.cpp:274-308).
+        Only ``MPI_SUM`` is differentiable; other ops raise in backward."""
+        return self._backend().allreduce(tensor, op)
+
+    def Bcast_(self, tensor, root: int):
+        """Broadcast from ``root`` (reference: src/__init__.py:154-175)."""
+        return self._backend().bcast_(tensor, root)
+
+    def Reduce_(self, tensor, op: int, root: int):
+        """Reduce to ``root``; non-root results are zeroed and the input is
+        consumed (reference: src/__init__.py:177-210,
+        csrc/extension.cpp:405-464)."""
+        return self._backend().reduce_(tensor, op, root)
+
+    def Gather(self, tensor, gatheraxis: int, root: int):
+        """Concatenate per-rank tensors along ``gatheraxis`` on ``root``;
+        per-rank axis lengths may differ (reference: src/__init__.py:212-213,
+        csrc/extension.cpp:497-599)."""
+        return self._backend().gather(tensor, gatheraxis, root)
+
+    def Allgather(self, tensor, gatheraxis: int):
+        """Gather with the result on every rank (reference:
+        src/__init__.py:215-216, csrc/extension.cpp:633-734)."""
+        return self._backend().allgather(tensor, gatheraxis)
+
+    def Scatter(self, tensor, scatteraxis: int, numelem: int, root: int):
+        """Split ``root``'s tensor along ``scatteraxis``; this rank keeps
+        ``numelem`` entries.  Non-root input shapes are ignored (reference:
+        src/__init__.py:218-219, csrc/extension.cpp:769-884)."""
+        return self._backend().scatter(tensor, scatteraxis, numelem, root)
+
+    def Alltoall(self, tensor, gatheraxis: int, scatteraxis: int, numelem: int):
+        """Combined gather/redistribute (reference: src/__init__.py:221-223,
+        csrc/extension.cpp:917-987)."""
+        return self._backend().alltoall(tensor, gatheraxis, scatteraxis, numelem)
+
+    # ------------------------------------------------------------------ p2p
+
+    def Isend(self, tensor, dest: int, tag: int) -> WaitHandle:
+        """Nonblocking send (reference: src/__init__.py:225-226)."""
+        return WaitHandle(self._backend().isend(tensor, dest, tag))
+
+    def Irecv(self, tensor, source: int, tag: int) -> WaitHandle:
+        """Nonblocking receive into ``tensor``'s shape (reference:
+        src/__init__.py:228-229)."""
+        return WaitHandle(self._backend().irecv(tensor, source, tag))
+
+    def Wait(self, waithandle: WaitHandle):
+        """Complete a nonblocking request (reference: src/__init__.py:231-232,
+        csrc/extension.cpp:1220-1265)."""
+        return self._backend().wait(waithandle._handle)
+
+    def Send(self, tensor, dest: int, tag: int):
+        """Blocking send = Isend + Wait (reference: src/__init__.py:234-236)."""
+        b = self._backend()
+        return b.wait(b.isend(tensor, dest, tag))
+
+    def Recv(self, tensor, source: int, tag: int):
+        """Blocking receive = Irecv + Wait (reference:
+        src/__init__.py:238-240)."""
+        b = self._backend()
+        return b.wait(b.irecv(tensor, source, tag))
+
+
+class _EagerBackend:
+    """Binds the op table to a concrete (world, rank) thread context."""
+
+    def __init__(self, ctx: RankContext):
+        self._ctx = ctx
+
+    @property
+    def rank(self) -> int:
+        return self._ctx.rank
+
+    @property
+    def size(self) -> int:
+        return self._ctx.world.size
+
+    def allreduce(self, x, op):
+        return _eager.allreduce(self._ctx, x, op)
+
+    def bcast_(self, x, root):
+        return _eager.bcast_(self._ctx, x, root)
+
+    def reduce_(self, x, op, root):
+        return _eager.reduce_(self._ctx, x, op, root)
+
+    def gather(self, x, gatheraxis, root):
+        return _eager.gather(self._ctx, x, gatheraxis, root)
+
+    def allgather(self, x, gatheraxis):
+        return _eager.allgather(self._ctx, x, gatheraxis)
+
+    def scatter(self, x, scatteraxis, numelem, root):
+        return _eager.scatter(self._ctx, x, scatteraxis, numelem, root)
+
+    def alltoall(self, x, gatheraxis, scatteraxis, numelem):
+        return _eager.alltoall(self._ctx, x, gatheraxis, scatteraxis, numelem)
+
+    def isend(self, x, dest, tag):
+        return _eager.isend(self._ctx, x, dest, tag)
+
+    def irecv(self, x, source, tag):
+        return _eager.irecv(self._ctx, x, source, tag)
+
+    def wait(self, handle):
+        return _eager.wait(self._ctx, handle)
+
+
+def _default_resolver():
+    """COMM_WORLD backend resolution: active SPMD trace context first, then
+    the current rank-thread, then the size-1 default world."""
+    spmd_ctx = _spmd_context()
+    if spmd_ctx is not None and current_rank_context() is None:
+        from .ops import spmd as _spmd
+        return _spmd.SpmdBackend(spmd_ctx)
+    return _EagerBackend(effective_rank_context())
+
+
+def _restore_comm_world():
+    """Unpickle target: the COMM_WORLD singleton (its backend re-resolves
+    in the loading process, so a communicator pickled on rank r of one run
+    is THE world of whatever context deserializes it — the only portable
+    meaning, and what the reference's broken deserializer intended)."""
+    return COMM_WORLD
+
+
+COMM_WORLD = MPI_Communicator()
+"""World communicator (reference: src/__init__.py:242-245).  Resolves
+dynamically: to the current rank-thread inside :func:`run_ranks`, to the
+mesh axis inside ``run_spmd``, and to a size-1 world otherwise."""
+
+
+def comm_from_mesh(mesh, axis_name: str) -> MPI_Communicator:
+    """Adopt a foreign :class:`jax.sharding.Mesh` axis as a communicator —
+    the TPU-native analogue of the reference's mpi4py/Fortran-handle interop
+    (csrc/extension.cpp:168-171, src/__init__.py:247-261): the mesh is the
+    process group, the named axis is the communicator."""
+    from .ops import spmd as _spmd
+    return _spmd.comm_from_mesh(mesh, axis_name)
+
+
+def comm_from_mpi4py(comm) -> MPI_Communicator:
+    """Convert an mpi4py communicator (reference: src/__init__.py:247-261).
+
+    Provided for API parity: this framework replaces the MPI process group
+    with a JAX device mesh, so mpi4py interop only applies when mpi4py is
+    co-installed and the process layout matches; otherwise use
+    :func:`comm_from_mesh`."""
+    try:
+        from mpi4py import MPI as _MPI  # noqa: F401
+    except ModuleNotFoundError:
+        raise RuntimeError("mpi4py is not available!")
+    raise RuntimeError(
+        "mpi4py interop requires an MPI-launched process layout; use "
+        "comm_from_mesh(mesh, axis_name) to adopt a JAX mesh instead"
+    )
+
+
+def deactivate_cuda_aware_mpi_support() -> None:
+    """API-parity no-op for the reference's CUDA-awareness kill-switch
+    (csrc/extension.cpp:54-59, 1404-1414).  The TPU backend has no
+    CUDA-aware-MPI staging decision — collectives always run device-native
+    over ICI/DCN — so there is nothing to toggle; the function exists so
+    reference scripts import and run unmodified."""

@@ -1,0 +1,37 @@
+"""Framework configuration flags.
+
+The reference has no config system (SURVEY.md §5: three compile-time toggles
+total).  This framework adds exactly one semantic knob:
+
+``deterministic_reductions`` — when True, SPMD-mode SUM reductions are
+computed as an all-gather followed by a fixed ascending-rank-order fold,
+which is bit-identical to the eager thread-SPMD oracle (the 'MPI linear
+order' reference) at the cost of bandwidth; when False (default), they lower
+to ``lax.psum`` — the XLA/ICI-native reduction, fastest but with
+compiler-chosen combining order (ulp-level differences possible).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_state = threading.local()
+
+
+def deterministic_reductions() -> bool:
+    return getattr(_state, "deterministic", False)
+
+
+def set_deterministic_reductions(value: bool) -> None:
+    _state.deterministic = bool(value)
+
+
+@contextmanager
+def deterministic_mode(value: bool = True):
+    prev = deterministic_reductions()
+    set_deterministic_reductions(value)
+    try:
+        yield
+    finally:
+        set_deterministic_reductions(prev)

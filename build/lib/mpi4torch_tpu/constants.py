@@ -1,0 +1,146 @@
+"""Reduction-op constants and combine rules.
+
+Mirrors the reference's library-stable op-code enum ``Mpi4torchCollectiveOps``
+(reference: csrc/extension.cpp:204-252) and its torch→MPI dtype mapping
+(csrc/extension.cpp:106-129).  The reference supports only
+Byte/Char/Short/Int/Long/Float/Double; this framework is a superset: every
+dtype JAX supports (including bfloat16/float16, bool, complex) is accepted,
+because on TPU bfloat16 is the native matmul/collective dtype.
+
+Op-code values are identical to the reference enum so that serialized
+descriptors are interchangeable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Library-stable integer codes (reference: csrc/extension.cpp:204-217).
+MPI_MAX = 1
+MPI_MIN = 2
+MPI_SUM = 3
+MPI_PROD = 4
+MPI_LAND = 5
+MPI_BAND = 6
+MPI_LOR = 7
+MPI_BOR = 8
+MPI_LXOR = 9
+MPI_BXOR = 10
+MPI_MINLOC = 11
+MPI_MAXLOC = 12
+
+_OP_NAMES = {
+    MPI_MAX: "MPI_MAX",
+    MPI_MIN: "MPI_MIN",
+    MPI_SUM: "MPI_SUM",
+    MPI_PROD: "MPI_PROD",
+    MPI_LAND: "MPI_LAND",
+    MPI_BAND: "MPI_BAND",
+    MPI_LOR: "MPI_LOR",
+    MPI_BOR: "MPI_BOR",
+    MPI_LXOR: "MPI_LXOR",
+    MPI_BXOR: "MPI_BXOR",
+    MPI_MINLOC: "MPI_MINLOC",
+    MPI_MAXLOC: "MPI_MAXLOC",
+}
+
+
+def op_name(op: int) -> str:
+    return _OP_NAMES.get(op, f"<unknown op {op}>")
+
+
+def combine2(op: int, a, b):
+    """Elementwise combination of two operands for reduction op ``op``.
+
+    Used by the eager (thread-SPMD) backend to reduce deterministically in
+    ascending rank order — the analogue of MPI's commutative-op reduction but
+    with a *fixed* evaluation order, which is what makes gradients bit-exact
+    and run-to-run reproducible (BASELINE.md north-star requirement).
+
+    MPI_MINLOC/MPI_MAXLOC operate on (value, index) pairs in MPI; the
+    reference forwards them to MPI with a scalar datatype, which MPI rejects
+    at runtime (csrc/extension.cpp:106-129 has no pair types).  We reject
+    them here with a clear error instead.
+
+    Plain-numpy operands combine in numpy so their dtype is preserved
+    exactly (jnp would canonicalize f64->f32 with x64 off), keeping the
+    fallback fold bit-equal to the native kernel for every op.
+    """
+    import numpy as _np
+    xp = _np if (isinstance(a, _np.ndarray) and isinstance(b, _np.ndarray)) \
+        else jnp
+    if op == MPI_SUM:
+        return a + b
+    if op == MPI_MAX:
+        return xp.maximum(a, b)
+    if op == MPI_MIN:
+        return xp.minimum(a, b)
+    if op == MPI_PROD:
+        return a * b
+    if op == MPI_LAND:
+        return xp.logical_and(a != 0, b != 0).astype(a.dtype)
+    if op == MPI_BAND:
+        return a & b
+    if op == MPI_LOR:
+        return xp.logical_or(a != 0, b != 0).astype(a.dtype)
+    if op == MPI_BOR:
+        return a | b
+    if op == MPI_LXOR:
+        return xp.logical_xor(a != 0, b != 0).astype(a.dtype)
+    if op == MPI_BXOR:
+        return a ^ b
+    if op in (MPI_MINLOC, MPI_MAXLOC):
+        raise NotImplementedError(
+            f"{op_name(op)} requires (value, index) pair semantics; the MPI "
+            "reference forwards plain tensors to MPI which rejects them at "
+            "runtime (no pair datatype in csrc/extension.cpp:106-129). "
+            "Use Allreduce(MPI_MIN/MPI_MAX) plus an argmin/argmax instead."
+        )
+    raise ValueError(f"Unknown reduction op code {op}")
+
+
+# Below this element count the N-1 jnp folds beat the host round-trip of
+# the native kernel.
+_NATIVE_REDUCE_MIN_SIZE = 32768
+
+
+def _on_cpu(v) -> bool:
+    try:
+        return all(d.platform == "cpu" for d in v.devices())
+    except AttributeError:
+        return True  # plain numpy
+
+
+def reduce_ordered(op: int, values):
+    """Reduce a list of per-rank tensors in ascending rank order.
+
+    Fixed linear order => deterministic, reproducible floating-point results
+    (the 'MPI reference oracle' for the bit-exactness target in BASELINE.md).
+    Large CPU-resident operands take the fused native kernel
+    (mpi4torch_tpu/_native), which folds in the identical order in one
+    memory pass; the pure-JAX fold is the always-available fallback and is
+    bit-equal.
+    """
+    if not values:
+        raise ValueError("reduce_ordered needs at least one value")
+    if len(values) > 1:
+        first = values[0]
+        if (getattr(first, "size", 0) >= _NATIVE_REDUCE_MIN_SIZE
+                and all(_on_cpu(v) for v in values)):
+            from . import _native
+            if _native.available():
+                import numpy as np
+                res = _native.ordered_reduce(
+                    [np.asarray(v) for v in values], op)
+                if res is not None:
+                    # JAX inputs already carry canonical dtypes, so the
+                    # round-trip is lossless; plain-numpy inputs keep their
+                    # numpy dtype exactly like the fallback fold would
+                    # (jnp.asarray would downcast f64/i64 with x64 off).
+                    if any(hasattr(v, "devices") for v in values):
+                        return jnp.asarray(res)
+                    return res
+    out = values[0]
+    for v in values[1:]:
+        out = combine2(op, out, v)
+    return out

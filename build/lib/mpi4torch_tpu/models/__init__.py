@@ -1,0 +1,14 @@
+"""Model zoo built ON the communication primitives.
+
+The reference ships no models (SURVEY.md §0: "no models, no trainer") — its
+examples hand-build data parallelism from `Allreduce`.  This package provides
+the same thing at framework quality: small pure-JAX model families whose
+*distribution* is expressed exclusively through the mpi4torch_tpu op surface
+(`Allreduce`, `Alltoall`, `Isend/Irecv/Wait`, ...), so they double as
+executable documentation of each parallelism strategy (SURVEY.md §2.5) and
+as the flagship programs for the benchmark/graft entry points.
+"""
+
+from . import mlp, resnet, transformer
+
+__all__ = ["mlp", "resnet", "transformer"]

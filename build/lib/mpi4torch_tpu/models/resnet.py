@@ -1,0 +1,231 @@
+"""ResNet-18 (CIFAR variant), TPU-native, for data-parallel training.
+
+BASELINE.md parity config #4: "Data-parallel ResNet-18/CIFAR-10,
+per-param-grad Allreduce".  The reference ships no models (SURVEY.md §0) —
+DP is a user pattern over its differentiable Allreduce (reference:
+examples/simple_linear_regression.py:27-35, README.md:34-46); this module
+provides the model the config names plus both DP recipes:
+
+* :func:`dp_grad_train_step` — the classic DDP recipe the config asks for:
+  local backward, then one ``Allreduce(grad, MPI_SUM)/size`` per parameter
+  leaf.  Here the Allreduce runs on *gradient values* (no AD through it).
+* :func:`dp_loss_train_step` — the reference's own pattern: collectives
+  inside the loss, gradients produced by the *adjoint* Allreduce.
+
+Both keep replicas bit-identical in lock-step (tests/test_resnet.py).
+
+TPU-first design choices: NHWC activations and HWIO filters (the XLA/TPU
+native convolution layout — no transposes around the MXU), all compute in
+batched convs/matmuls, BatchNorm as a pure function threading running
+statistics through the step (JAX is functional; there is no module state).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import MPI_SUM
+
+# NHWC / HWIO / NHWC: the TPU-native convolution dimension numbers.
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    """CIFAR-style ResNet-18: 3x3 stem (no max-pool), 4 stages of 2 basic
+    blocks at widths (64, 128, 256, 512), global average pool, linear head."""
+
+    num_classes: int = 10
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)
+    widths: Tuple[int, ...] = (64, 128, 256, 512)
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), dtype)
+    return w * jnp.sqrt(jnp.asarray(2.0 / fan_in, dtype))
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_state_init(c, dtype):
+    return {"mean": jnp.zeros((c,), dtype), "var": jnp.ones((c,), dtype)}
+
+
+def _block_stride(si: int, bi: int) -> int:
+    """The single source of truth for block strides (init and forward must
+    agree): the first block of every stage after the first downsamples."""
+    return 2 if (bi == 0 and si > 0) else 1
+
+
+def init_resnet(key, cfg: ResNetConfig, in_channels: int = 3,
+                dtype=jnp.float32):
+    """Returns ``(params, state)`` pytrees.
+
+    ``params`` are the trainable leaves (conv filters, BN affine, head);
+    ``state`` is the non-trainable BN running statistics, threaded through
+    :func:`forward` functionally."""
+    def next_key():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return sub
+
+    params = {"stem": {"conv": _conv_init(next_key(), 3, 3, in_channels,
+                                          cfg.widths[0], dtype),
+                       "bn": _bn_init(cfg.widths[0], dtype)}}
+    state = {"stem": {"bn": _bn_state_init(cfg.widths[0], dtype)}}
+
+    cin = cfg.widths[0]
+    stages = []
+    stages_state = []
+    for si, (width, nblocks) in enumerate(zip(cfg.widths, cfg.stage_sizes)):
+        blocks = []
+        blocks_state = []
+        for bi in range(nblocks):
+            stride = _block_stride(si, bi)
+            block = {
+                "conv1": _conv_init(next_key(), 3, 3, cin, width, dtype),
+                "bn1": _bn_init(width, dtype),
+                "conv2": _conv_init(next_key(), 3, 3, width, width, dtype),
+                "bn2": _bn_init(width, dtype),
+            }
+            bstate = {"bn1": _bn_state_init(width, dtype),
+                      "bn2": _bn_state_init(width, dtype)}
+            if stride != 1 or cin != width:
+                block["proj"] = _conv_init(next_key(), 1, 1, cin, width,
+                                           dtype)
+                block["bn_proj"] = _bn_init(width, dtype)
+                bstate["bn_proj"] = _bn_state_init(width, dtype)
+            blocks.append(block)
+            blocks_state.append(bstate)
+            cin = width
+        stages.append(blocks)
+        stages_state.append(blocks_state)
+    params["stages"] = stages
+    state["stages"] = stages_state
+
+    wk = next_key()
+    params["head"] = {
+        "w": jax.random.normal(wk, (cin, cfg.num_classes), dtype)
+        / jnp.sqrt(jnp.asarray(cin, dtype)),
+        "b": jnp.zeros((cfg.num_classes,), dtype),
+    }
+    return params, state
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=_DIMNUMS)
+
+
+def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
+    """Pure-function BatchNorm over (N, H, W); returns (y, new_state).
+
+    In train mode the normalizing statistics are the *local batch's* — under
+    DP each rank normalizes its own shard (the standard non-synced-BN DDP
+    semantics); running stats are an EMA carried in ``state``."""
+    if train:
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.var(x, axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new_s = {"mean": m * s["mean"] + (1 - m) * mean,
+                 "var": m * s["var"] + (1 - m) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + cfg.bn_eps)
+    y = (x - mean) * inv * p["scale"] + p["bias"]
+    return y, new_s
+
+
+def _basic_block(x, p, s, cfg, stride, train):
+    y, s1 = _batch_norm(_conv(x, p["conv1"], stride), p["bn1"], s["bn1"],
+                        cfg, train)
+    y = jax.nn.relu(y)
+    y, s2 = _batch_norm(_conv(y, p["conv2"]), p["bn2"], s["bn2"], cfg, train)
+    new_s = {"bn1": s1, "bn2": s2}
+    if "proj" in p:
+        x, sp = _batch_norm(_conv(x, p["proj"], stride), p["bn_proj"],
+                            s["bn_proj"], cfg, train)
+        new_s["bn_proj"] = sp
+    return jax.nn.relu(x + y), new_s
+
+
+def forward(cfg: ResNetConfig, params, state, images, train: bool = True):
+    """Logits for NHWC ``images``; returns ``(logits, new_state)``."""
+    x, stem_s = _batch_norm(_conv(images, params["stem"]["conv"]),
+                            params["stem"]["bn"], state["stem"]["bn"],
+                            cfg, train)
+    x = jax.nn.relu(x)
+    new_state = {"stem": {"bn": stem_s}, "stages": []}
+    for si, (blocks, bstates, width) in enumerate(
+            zip(params["stages"], state["stages"], cfg.widths)):
+        stage_s = []
+        for bi, (bp, bs) in enumerate(zip(blocks, bstates)):
+            x, ns = _basic_block(x, bp, bs, cfg, _block_stride(si, bi), train)
+            stage_s.append(ns)
+        new_state["stages"].append(stage_s)
+    x = jnp.mean(x, axis=(1, 2))
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_state
+
+
+def local_loss(cfg: ResNetConfig, params, state, batch, train: bool = True):
+    """Mean softmax cross-entropy on the rank-local batch; returns
+    ``(loss, new_state)``."""
+    images, labels = batch
+    logits, new_state = forward(cfg, params, state, images, train)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(ce), new_state
+
+
+def dp_grad_train_step(comm, cfg: ResNetConfig, params, state, batch,
+                       lr: float = 0.1):
+    """One SGD step with the classic DDP recipe (BASELINE.md config #4):
+    local backward first, then one ``Allreduce(g, MPI_SUM)/size`` per
+    parameter gradient.  Returns ``(global_loss, new_params, new_state)``.
+
+    The Allreduce here acts on already-computed gradient *values* — the
+    same call as the reference's, just on the other side of backward.  BN
+    running stats are likewise Allreduce-averaged so evaluation state stays
+    replica-identical."""
+    (loss, new_state), grads = jax.value_and_grad(
+        lambda p: local_loss(cfg, p, state, batch), has_aux=True)(params)
+    size = comm.size
+    grads = jax.tree.map(lambda g: comm.Allreduce(g, MPI_SUM) / size, grads)
+    global_loss = comm.Allreduce(loss, MPI_SUM) / size
+    new_state = jax.tree.map(
+        lambda s: comm.Allreduce(s, MPI_SUM) / size, new_state)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return global_loss, new_params, new_state
+
+
+def dp_loss_train_step(comm, cfg: ResNetConfig, params, state, batch,
+                       lr: float = 0.1):
+    """One SGD step with the reference's in-loss recipe (parameter-averaging
+    Allreduce + loss Allreduce; gradients come from the *adjoint* Allreduce
+    — reference: doc/examples.rst:24-65).  Returns
+    ``(global_loss, new_params, new_state)``."""
+    size = comm.size
+
+    def global_loss_fn(p):
+        p = jax.tree.map(lambda t: comm.Allreduce(t, MPI_SUM) / size, p)
+        loss, ns = local_loss(cfg, p, state, batch)
+        return comm.Allreduce(loss, MPI_SUM) / size, ns
+
+    (loss, new_state), grads = jax.value_and_grad(
+        global_loss_fn, has_aux=True)(params)
+    new_state = jax.tree.map(
+        lambda s: comm.Allreduce(s, MPI_SUM) / size, new_state)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return loss, new_params, new_state

@@ -39,15 +39,7 @@ class TransformerConfig:
     ``n_experts > 0`` switches every block's FFN to an expert-parallel MoE
     (capacity-based top-1 routing over the differentiable ``Alltoall``,
     parallel/moe.py); ``capacity`` is the per-(expert, source-rank) slot
-    count, ``aux_coef`` weights the load-balancing loss in :func:`lm_loss`.
-
-    ``remat`` rematerializes each block in the backward pass
-    (``jax.checkpoint``): activation memory drops from O(layers) to O(1)
-    blocks at the cost of one extra forward — the HBM-for-FLOPs trade.
-    Collectives inside a rematted block re-execute during backward, which
-    is SPMD-symmetric (every rank reruns the same sequence, so no
-    deadlock); it requires the traced (SPMD/jit) path — the eager
-    thread-SPMD backend's ops execute imperatively and refuse tracing."""
+    count, ``aux_coef`` weights the load-balancing loss in :func:`lm_loss`."""
     vocab: int
     d_model: int
     n_heads: int
@@ -57,7 +49,6 @@ class TransformerConfig:
     n_experts: int = 0
     capacity: int = 0
     aux_coef: float = 0.01
-    remat: bool = False
 
     def __post_init__(self):
         if self.n_experts > 0 and self.capacity <= 0:
@@ -161,8 +152,7 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     x = params["embed"][tokens] + pos[None]
     d = x.shape[-1]
     aux_total = jnp.zeros((), x.dtype)
-
-    def block_fn(x, blk):
+    for blk in params["blocks"]:
         y = _layer_norm(x, blk["ln1"])
         qkv = y @ blk["wqkv"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -177,16 +167,9 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
             else:
                 ff, aux = moe_ffn_dense(flat, blk["moe"], cfg.capacity)
             x = x + ff.reshape(b, s_local, d)
+            aux_total = aux_total + aux
         else:
             x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
-            aux = jnp.zeros((), x.dtype)
-        return x, aux
-
-    if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
-    for blk in params["blocks"]:
-        x, aux = block_fn(x, blk)
-        aux_total = aux_total + aux
     x = _layer_norm(x, params["ln_f"])
     logits = x @ params["unembed"]
     if return_aux:

@@ -1,0 +1,17 @@
+"""Differentiable communication ops.
+
+Two backends implement the same op table (SURVEY.md §2.2):
+
+* :mod:`mpi4torch_tpu.ops.eager` — thread-SPMD eager execution with concrete
+  per-rank shapes/ranks (the ``mpirun`` parity harness, Mode B).
+* :mod:`mpi4torch_tpu.ops.spmd` — single-trace SPMD over a named mesh axis,
+  lowering to XLA collectives over ICI/DCN (the TPU performance path, Mode A).
+
+:mod:`mpi4torch_tpu.ops.flash` provides the fused (Pallas) block-attention
+kernel that :func:`mpi4torch_tpu.parallel.ring_attention` composes over the
+ring, with a jnp fallback for ineligible shapes/platforms.
+"""
+
+from .flash import flash_attention, flash_block_attention, merge_partials
+
+__all__ = ["flash_attention", "flash_block_attention", "merge_partials"]

@@ -1,0 +1,570 @@
+"""Mode B: differentiable communication ops for the thread-SPMD eager runtime.
+
+Each op mirrors a row of the reference op table (SURVEY.md §2.2): a forward
+communication whose backward (registered through ``jax.custom_vjp``, the JAX
+analogue of the reference's hand-built ``torch::autograd::Node`` subclasses)
+is itself the *adjoint* communication op:
+
+    Allreduce(SUM)  <-> Allreduce(SUM)      (self-adjoint; csrc/extension.cpp:254-308)
+    Bcast_(root)    <-> Reduce_(SUM, root)  (csrc/extension.cpp:310-365)
+    Reduce_(SUM,r)  <-> Bcast_(r)           (csrc/extension.cpp:367-464)
+    Gather(ax,r)    <-> Scatter(ax,n,r)     (csrc/extension.cpp:466-599)
+    Allgather(ax)   <-> reduce-scatter      (csrc/extension.cpp:601-734; see note)
+    Scatter(ax,n,r) <-> Gather(ax,r)        (csrc/extension.cpp:736-884)
+    Alltoall(g,s,n) <-> Alltoall(s,g,n')    (csrc/extension.cpp:886-987)
+    Isend/Irecv/Wait <-> reverse-direction Irecv/Isend/Wait on tag+10
+                                            (csrc/extension.cpp:1048-1265)
+
+Divergence note (Allgather): the reference's Allgather backward contains a
+latent bug — its scatter loop uses constant root 1 instead of the loop index
+(csrc/extension.cpp:627), which is only correct when the upstream gradient is
+rank-uniform.  We implement the mathematically correct adjoint (an ordered
+reduce-scatter), as SURVEY.md §2.2 prescribes.
+
+Reductions are evaluated in ascending rank order (constants.reduce_ordered),
+making results deterministic and bit-reproducible — the oracle for the
+BASELINE.md bit-exactness target.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants as C
+from ..runtime import (
+    REQ_IRECV,
+    REQ_ISEND,
+    CommError,
+    RankContext,
+)
+
+# Gradient messages travel on tag+GRAD_TAG_OFFSET to keep forward- and
+# reverse-flow messages apart (reference: csrc/extension.cpp:1161).
+GRAD_TAG_OFFSET = 10
+
+# Descriptor layout: 8 float32s.  The reference packs the MPI request into a
+# 7-double tensor [req, op, peer, tag, ptr_hash, devtype, devidx]
+# (csrc/extension.cpp:1094-1102); we add one slot because the 31-bit
+# fingerprint is split into two 16-bit halves to stay exact in float32.
+_DESC_LEN = 8
+
+
+def _check_concrete(*arrays: Any) -> None:
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            raise CommError(
+                "thread-SPMD (eager) communication ops cannot run under "
+                "jit/vmap/scan tracing — they rendezvous across rank-threads "
+                "at Python level.  Use the SPMD mesh backend "
+                "(mpi4torch_tpu.ops.spmd / run_spmd) for traced/compiled "
+                "code paths."
+            )
+
+
+def _norm_axis(axis: int, ndim: int) -> int:
+    a = axis + ndim if axis < 0 else axis
+    if not (0 <= a < ndim):
+        raise ValueError(f"axis {axis} out of range for ndim {ndim}")
+    return a
+
+
+def _shape_sig(x) -> Tuple:
+    return (tuple(x.shape), str(jnp.asarray(x).dtype))
+
+
+# =========================================================================
+# Blocking collectives
+# =========================================================================
+
+def allreduce(ctx: RankContext, x, op: int):
+    """Differentiable Allreduce (reference: csrc/extension.cpp:274-308).
+
+    Only MPI_SUM has a defined adjoint; other ops raise at *backward* time,
+    matching the reference's MPIUnimplementedNode (csrc/extension.cpp:194-202,
+    279-283)."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+
+    def impl(v):
+        _check_concrete(v)
+        vals = world.exchange(rank, ("Allreduce", op, _shape_sig(v)), v)
+        return C.reduce_ordered(op, vals)
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    def fwd(v):
+        return impl(v), None
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Allreduce with {C.op_name(op)} is not "
+                "implemented — only MPI_SUM is differentiable (reference: "
+                "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
+            )
+        return (impl(g),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+def bcast_(ctx: RankContext, x, root: int):
+    """Differentiable broadcast, in-place in the reference
+    (csrc/extension.cpp:333-365).  Functionally pure here: returns the root's
+    tensor on every rank.  Adjoint: Reduce_(grad, SUM, root)
+    (csrc/extension.cpp:310-331)."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    _check_root(world, root)
+
+    def impl(v):
+        _check_concrete(v)
+        vals = world.exchange(rank, ("Bcast_", root, _shape_sig(v)), v)
+        return vals[root]
+
+    def reduce_impl(g):
+        _check_concrete(g)
+        vals = world.exchange(rank, ("Bcast_.bwd", root, _shape_sig(g)), g)
+        red = C.reduce_ordered(C.MPI_SUM, vals)
+        return red if rank == root else jnp.zeros_like(red)
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    f.defvjp(lambda v: (impl(v), None), lambda _, g: (reduce_impl(g),))
+    return f(x)
+
+
+def reduce_(ctx: RankContext, x, op: int, root: int):
+    """Differentiable reduce-to-root (reference: csrc/extension.cpp:405-464).
+
+    Matches the reference's observable semantics: the result on non-root
+    ranks is zeroed "to make the function properly behaved"
+    (csrc/extension.cpp:443-447), and the *input* is marked consumed so later
+    communication ops reject it — the analogue of the MPINoInplaceBackward
+    reuse guard (csrc/extension.cpp:395-403, 451-462).  Adjoint:
+    Bcast_(grad, root); only MPI_SUM is differentiable."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    _check_root(world, root)
+
+    def impl(v):
+        _check_concrete(v)
+        vals = world.exchange(rank, ("Reduce_", op, root, _shape_sig(v)), v)
+        red = C.reduce_ordered(op, vals)
+        return red if rank == root else jnp.zeros_like(red)
+
+    def bcast_impl(g):
+        _check_concrete(g)
+        vals = world.exchange(rank, ("Reduce_.bwd", root, _shape_sig(g)), g)
+        return vals[root]
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Reduce_ with {C.op_name(op)} is not "
+                "implemented — only MPI_SUM is differentiable (reference: "
+                "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
+            )
+        return (bcast_impl(g),)
+
+    f.defvjp(lambda v: (impl(v), None), bwd)
+    out = f(x)
+    world.mark_consumed(x)
+    return out
+
+
+def _gather_impl(ctx: RankContext, v, axis: int, root: int):
+    """Shared forward machinery for Gather: per-rank-varying axis lengths are
+    exchanged alongside the data (the reference exchanges axis lengths via an
+    inner MPI_Gather and builds derived datatypes, csrc/extension.cpp:540-586;
+    the thread runtime can simply ship the arrays)."""
+    world, rank = ctx.world, ctx.rank
+    _check_concrete(v)
+    ax = _norm_axis(axis, jnp.ndim(v))
+    othershape = tuple(s for i, s in enumerate(v.shape) if i != ax)
+    sig = ("Gather", ax, root, othershape, str(jnp.asarray(v).dtype))
+    vals = world.exchange(rank, sig, v)
+    gathered = jnp.concatenate(vals, axis=ax)
+    return gathered if rank == root else jnp.zeros_like(gathered)
+
+
+def _scatter_impl(ctx: RankContext, v, axis: int, numelem: int, root: int):
+    """Shared forward machinery for Scatter: the output ndim/shape is
+    broadcast from the root — non-root inputs' shapes are ignored
+    (csrc/extension.cpp:788-796); per-receiver counts are gathered from each
+    rank's ``numelem`` (csrc/extension.cpp:819-823) and validated against the
+    root's axis length (csrc/extension.cpp:835-837)."""
+    world, rank = ctx.world, ctx.rank
+    _check_concrete(v)
+    vals = world.exchange(rank, ("Scatter", axis, root), (int(numelem), v))
+    counts = [int(n) for n, _ in vals]
+    t = vals[root][1]
+    ax = _norm_axis(axis, jnp.ndim(t))
+    axlen = t.shape[ax]
+    if sum(counts) != axlen:
+        raise ValueError(
+            f"Scatter: sum of per-rank numelem {counts} = {sum(counts)} does "
+            f"not match the root's axis length {axlen} along axis {ax} "
+            "(reference check csrc/extension.cpp:835-837)"
+        )
+    offset = sum(counts[:rank])
+    index = [slice(None)] * jnp.ndim(t)
+    index[ax] = slice(offset, offset + counts[rank])
+    return t[tuple(index)]
+
+
+def gather(ctx: RankContext, x, gatheraxis: int, root: int):
+    """Differentiable gather along an arbitrary axis with per-rank-varying
+    shard sizes (reference: csrc/extension.cpp:497-599).  Adjoint:
+    Scatter(grad, gatheraxis, numelem, root) with ``numelem`` = the local
+    axis length captured at forward time (csrc/extension.cpp:503)."""
+    world = ctx.world
+    world.check_not_consumed(x)
+    _check_root(world, root)
+    ax = _norm_axis(gatheraxis, jnp.ndim(x))
+    numelem = x.shape[ax]
+
+    @jax.custom_vjp
+    def f(v):
+        return _gather_impl(ctx, v, ax, root)
+
+    f.defvjp(
+        lambda v: (_gather_impl(ctx, v, ax, root), None),
+        lambda _, g: (_scatter_impl(ctx, g, ax, numelem, root),),
+    )
+    return f(x)
+
+
+def allgather(ctx: RankContext, x, gatheraxis: int):
+    """Differentiable allgather (reference: csrc/extension.cpp:633-734).
+
+    Adjoint: the mathematically correct reduce-scatter — every rank's input
+    gradient is the ordered sum over ranks of that rank's own segment of the
+    upstream gradients.  (The reference instead loops Scatters from a
+    constant root=1, csrc/extension.cpp:627 — correct only for rank-uniform
+    upstream gradients; see module docstring.)"""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    ax = _norm_axis(gatheraxis, jnp.ndim(x))
+    numelem = x.shape[ax]
+
+    def impl(v):
+        _check_concrete(v)
+        othershape = tuple(s for i, s in enumerate(v.shape) if i != ax)
+        sig = ("Allgather", ax, othershape, str(jnp.asarray(v).dtype))
+        vals = world.exchange(rank, sig, v)
+        return jnp.concatenate(vals, axis=ax), tuple(v.shape[ax] for v in vals)
+
+    def bwd_impl(counts, g):
+        _check_concrete(g)
+        vals = world.exchange(rank, ("Allgather.bwd", ax, _shape_sig(g)), g)
+        # Ordered reduce-scatter: slice my segment out of every rank's
+        # gradient and sum in rank order.  `counts` are the per-rank forward
+        # axis lengths, stashed as residuals at forward time.
+        offset = sum(counts[:rank])
+        index = [slice(None)] * jnp.ndim(g)
+        index[ax] = slice(offset, offset + counts[rank])
+        pieces = [v[tuple(index)] for v in vals]
+        return C.reduce_ordered(C.MPI_SUM, pieces)
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)[0]
+
+    f.defvjp(lambda v: impl(v), lambda counts, g: (bwd_impl(counts, g),))
+    return f(x)
+
+
+def scatter(ctx: RankContext, x, scatteraxis: int, numelem: int, root: int):
+    """Differentiable scatter from root along an arbitrary axis with
+    per-receiver counts (reference: csrc/extension.cpp:769-884).  Adjoint:
+    Gather(grad, scatteraxis, root); on non-root ranks the input gradient is
+    zeros, but the rank still *participates* in the backward gather so the
+    per-rank backward programs stay collectively consistent — the moral of
+    the reference's JoinDummies(zeros, {gather}) trick
+    (csrc/extension.cpp:756-766)."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    _check_root(world, root)
+    in_shape, in_dtype = tuple(x.shape), jnp.asarray(x).dtype
+
+    @jax.custom_vjp
+    def f(v):
+        return _scatter_impl(ctx, v, scatteraxis, numelem, root)
+
+    def bwd(_, g):
+        gathered = _gather_impl(ctx, g, _norm_axis(scatteraxis, jnp.ndim(g)), root)
+        if rank == root:
+            return (gathered.astype(in_dtype),)
+        return (jnp.zeros(in_shape, in_dtype),)
+
+    f.defvjp(lambda v: (_scatter_impl(ctx, v, scatteraxis, numelem, root), None), bwd)
+    return f(x)
+
+
+def alltoall(ctx: RankContext, x, gatheraxis: int, scatteraxis: int, numelem: int):
+    """Differentiable all-to-all: gather along ``gatheraxis``, redistribute
+    along ``scatteraxis`` with ``numelem`` kept locally (reference:
+    csrc/extension.cpp:917-987, implemented there as a loop of Scatters).
+    Forward is the Scatter∘Gather composition — the identity the reference's
+    own tests assert (tests/test_collectives.py:115-125).  Adjoint: the
+    axes-swapped Alltoall with ``numelem`` = the forward gather-axis local
+    length (csrc/extension.cpp:912, captured at 923)."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    ga = _norm_axis(gatheraxis, jnp.ndim(x))
+    back_numelem = x.shape[ga]
+
+    def impl(v, g_ax, s_ax, n):
+        gathered = _gather_impl(ctx, v, g_ax, 0)
+        return _scatter_impl(ctx, gathered, s_ax, n, 0)
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v, ga, scatteraxis, numelem)
+
+    f.defvjp(
+        lambda v: (impl(v, ga, scatteraxis, numelem), None),
+        lambda _, g: (impl(g, _norm_axis(scatteraxis, jnp.ndim(g)), ga,
+                           back_numelem),),
+    )
+    return f(x)
+
+
+def _check_root(world, root: int) -> None:
+    if not (0 <= root < world.size):
+        raise CommError(f"invalid root rank {root} (world size {world.size})")
+
+
+# =========================================================================
+# Dependency tokens: JoinDummies
+# =========================================================================
+
+def join_dummies(loopthrough, dummies: Sequence):
+    """The dependency-token primitive (reference: csrc/extension.cpp:989-1046).
+
+    Forward: identity on ``loopthrough``; ``dummies`` are tied in with an
+    ``optimization_barrier`` so XLA can neither dead-code-eliminate nor
+    reorder the communication that produced them (the XLA-token analogue of
+    the reference keeping dummies as autograd edges).  Backward: the real
+    gradient flows to ``loopthrough``; every dummy receives a *zero* gradient
+    that still carries the dependency chain (csrc/extension.cpp:1002-1021).
+
+    If no dummies are given the input is returned untouched
+    (csrc/extension.cpp:1030-1033)."""
+    dummies = list(dummies)
+    if not dummies:
+        return loopthrough
+    specs = tuple((tuple(d.shape), d.dtype) for d in dummies)
+
+    @jax.custom_vjp
+    def f(loop, *ds):
+        tied = jax.lax.optimization_barrier((loop,) + tuple(ds))
+        return tied[0]
+
+    def fwd(loop, *ds):
+        out = jax.lax.optimization_barrier((loop,) + tuple(ds))[0]
+        return out, None
+
+    def bwd(_, g):
+        zeros = tuple(jnp.zeros(s, d) for s, d in specs)
+        tied = jax.lax.optimization_barrier((g,) + zeros)
+        return (tied[0],) + tuple(tied[1:])
+
+    f.defvjp(fwd, bwd)
+    return f(loopthrough, *dummies)
+
+
+# =========================================================================
+# Nonblocking point-to-point: Isend / Irecv / Wait
+# =========================================================================
+
+def _make_descriptor(req) -> jnp.ndarray:
+    """Pack a request into an 8-float32 descriptor tensor so the handle can
+    travel through the AD graph as data, mirroring the reference's
+    request-in-a-tensor design (csrc/extension.cpp:1094-1102).
+
+    Layout: [rid_lo16, rid_hi16, kind, peer, tag, fp_lo16, fp_hi16, 0].
+    The 32-bit request id and 31-bit fingerprint are each split into 16-bit
+    halves so every slot stays integer-exact in float32 (float32 is only
+    exact up to 2^24)."""
+    return jnp.asarray(
+        [req.req_id & 0xFFFF, (req.req_id >> 16) & 0xFFFF,
+         req.kind, req.peer, req.tag,
+         req.fingerprint & 0xFFFF, (req.fingerprint >> 16) & 0xFFFF, 0],
+        dtype=jnp.float32,
+    )
+
+
+def _decode_descriptor(desc) -> Tuple[int, int, int, int, int]:
+    d = np.asarray(desc)
+    if d.shape != (_DESC_LEN,):
+        from ..runtime import BifurcationError
+        raise BifurcationError(
+            "Detected bifurcation in Wait handle usage: descriptor tensor has "
+            f"unexpected shape {d.shape}"
+        )
+    req_id = (int(d[1]) << 16) | int(d[0])
+    kind, peer, tag = int(d[2]), int(d[3]), int(d[4])
+    fingerprint = (int(d[6]) << 16) | int(d[5])
+    return req_id, kind, peer, tag, fingerprint
+
+
+def _check_tag(tag: int) -> None:
+    # Tags occupy one float32 descriptor slot and must stay integer-exact.
+    if not (0 <= tag < (1 << 24) - GRAD_TAG_OFFSET):
+        raise CommError(
+            f"tag {tag} out of range [0, 2^24 - {GRAD_TAG_OFFSET})"
+        )
+
+
+def isend(ctx: RankContext, x, dest: int, tag: int) -> List:
+    """Nonblocking send (reference: csrc/extension.cpp:1071-1113).
+
+    Returns the raw 3-tensor wait handle ``[descriptor, buffer, loopthrough]``
+    exactly like the reference (csrc/extension.cpp:1103-1107).  The eager
+    runtime uses buffered-send semantics: the payload is handed to the
+    destination mailbox immediately, and Wait on the send handle is a local
+    completion.  Backward: the gradient of the sent tensor *arrives over the
+    network* from ``dest`` on ``tag + 10`` (csrc/extension.cpp:1204-1208) and
+    is received inside this op's VJP (the analogue of
+    MPINonBlockingBackward -> MPIWait, csrc/extension.cpp:1061-1069)."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    _check_tag(tag)
+    req = world.new_request(REQ_ISEND, rank, dest, tag, tuple(x.shape),
+                            jnp.asarray(x).dtype)
+    desc = _make_descriptor(req)
+
+    def impl(v):
+        _check_concrete(v)
+        world.p2p_send(rank, dest, tag, v)
+        return desc, v, v
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    def bwd(_, gs):
+        g_desc, g_buf, g_loop = gs
+        g_remote = world.p2p_recv(dest, rank, tag + GRAD_TAG_OFFSET)
+        # Local identity-path contributions (buffer + loopthrough outputs)
+        # are added to the remote gradient; the reference drops them
+        # (its Wait output is a pure dependency token) — summing is the
+        # mathematically sound superset and agrees on all reference tests.
+        return (g_remote + g_buf + g_loop,)
+
+    f.defvjp(lambda v: (impl(v), None), bwd)
+    return list(f(x))
+
+
+def irecv(ctx: RankContext, x, source: int, tag: int) -> List:
+    """Nonblocking receive (reference: csrc/extension.cpp:1115-1157).
+
+    ``x`` is the receive buffer; its shape/dtype define the expected message.
+    Returns the raw 3-tensor wait handle.  The actual message is delivered at
+    Wait (rendezvous completion).  Backward: zero gradient for the
+    (overwritten) buffer; the gradient of the *received value* is sent back
+    to ``source`` by Wait's VJP (csrc/extension.cpp:1209-1212)."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(x)
+    _check_tag(tag)
+    req = world.new_request(REQ_IRECV, rank, source, tag, tuple(x.shape),
+                            jnp.asarray(x).dtype)
+    desc = _make_descriptor(req)
+
+    def impl(v):
+        _check_concrete(v)
+        return desc, v, v
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    def bwd(_, gs):
+        g_desc, g_buf, g_loop = gs
+        return (g_buf + g_loop,)
+
+    f.defvjp(lambda v: (impl(v), None), bwd)
+    return list(f(x))
+
+
+def wait(ctx: RankContext, handle: List):
+    """Complete a nonblocking request (reference: csrc/extension.cpp:1220-1265).
+
+    Decodes the descriptor, enforces both misuse guards — the fingerprint
+    re-check (csrc/extension.cpp:1231-1237) and exactly-once completion
+    (csrc/extension.cpp:1196-1202) — then returns the loop-through tensor for
+    send handles or the received message for recv handles.  Backward
+    (csrc/extension.cpp:1159-1218): for a recv handle, the output gradient is
+    *sent* back to the source on ``tag + 10``; for a send handle the local
+    contribution is routed to the Isend VJP, which receives the remote
+    gradient."""
+    world, rank = ctx.world, ctx.rank
+    desc, buf, loop = handle
+
+    def impl(d, b, l):
+        _check_concrete(b, l)
+        req_id, kind, peer, tag, fp = _decode_descriptor(d)
+        req = world.complete_request(req_id, tuple(b.shape),
+                                     jnp.asarray(b).dtype)
+        from ..runtime import BifurcationError
+        if req.fingerprint != fp or req.kind != kind:
+            raise BifurcationError(
+                "Detected bifurcation in Wait handle usage: descriptor "
+                "fingerprint does not match the posted request "
+                "(reference guard csrc/extension.cpp:1231-1237)"
+            )
+        if kind == REQ_ISEND:
+            return l
+        out = world.p2p_recv(peer, rank, tag)
+        if (tuple(out.shape) != tuple(b.shape)
+                or jnp.asarray(out).dtype != jnp.asarray(b).dtype):
+            raise CommError(
+                f"Recv buffer (shape {tuple(b.shape)}, dtype "
+                f"{jnp.asarray(b).dtype}) does not match the incoming message "
+                f"(shape {tuple(out.shape)}, dtype {jnp.asarray(out).dtype}) "
+                f"(source {peer}, tag {tag})"
+            )
+        return out
+
+    @jax.custom_vjp
+    def f(d, b, l):
+        return impl(d, b, l)
+
+    # Static metadata for backward zeros, available from the (possibly
+    # traced) handle parts at call time.
+    d_spec = (tuple(desc.shape), desc.dtype)
+    b_spec = (tuple(buf.shape), buf.dtype)
+    l_spec = (tuple(loop.shape), loop.dtype)
+
+    def fwd(d, b, l):
+        out = impl(d, b, l)
+        req_id, kind, peer, tag, fp = _decode_descriptor(d)
+        return out, (kind, peer, tag)
+
+    def bwd(res, g):
+        kind, peer, tag = res
+        zero_d = jnp.zeros(*d_spec)
+        zero_b = jnp.zeros(*b_spec)
+        if kind == REQ_ISEND:
+            # Route the local contribution to the loop-through slot; the
+            # matching Isend VJP adds the remote gradient.
+            return (zero_d, zero_b, g)
+        world.p2p_send(rank, int(peer), int(tag) + GRAD_TAG_OFFSET, g)
+        return (zero_d, zero_b, jnp.zeros(*l_spec))
+
+    f.defvjp(fwd, bwd)
+    return f(desc, buf, loop)

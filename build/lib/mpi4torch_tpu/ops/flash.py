@@ -1,0 +1,314 @@
+"""Fused block attention (flash-style) — the TPU hot-op kernel.
+
+The ring/dense attention in ``parallel.attention`` is algebraically a
+sequence of *block attention* calls merged by an online softmax.  This
+module provides that block primitive two ways behind one signature:
+
+* a Pallas TPU kernel (`pltpu`): q tiles stream through VMEM, the KV loop
+  runs fused in-core (scores, masking, online softmax, PV accumulation all
+  without materializing the (q, k) score matrix in HBM), MXU matmuls in
+  f32 accumulation;
+* a pure-jnp fallback with identical semantics for ineligible shapes and
+  non-TPU platforms (XLA still fuses it well on CPU; it is the oracle the
+  kernel is tested against, tests/test_flash.py).
+
+Returns **normalized** partials ``(out, lse)``: ``out`` is softmax(qkᵀ)v
+over the given KV block, ``lse`` the log-sum-exp of the (masked) scores.
+Two partials merge exactly (parallel/attention.py ``ring_attention``), so
+the primitive composes into context parallelism without renormalization
+error.  Fully-masked rows yield ``out = 0`` and ``lse = -BIG`` — the
+neutral element of the merge.
+
+Positions are passed as f32 offsets (exact to 2^24) so they may be
+*traced* values — under SPMD the block owner is rank-symbolic
+(``lax.axis_index`` arithmetic, SURVEY.md §7 hard part 4).
+
+Differentiable via ``jax.custom_vjp``: the backward recomputes the block
+scores (flash-style rematerialization; residuals are q/k/v/out/lse only)
+and is shared by both forward paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_BIG = -1e30
+_Q_TILE = 128
+_KV_TILE = 128
+
+
+# The kernel stages the whole KV block in VMEM per grid step (the KV loop
+# runs in-core); cap the staged bytes well under the ~16 MB/core VMEM so
+# q tiles, outputs and accumulators still fit.  Longer local blocks fall
+# back to the jnp path (ring attention keeps per-rank blocks short anyway).
+_KV_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _eligible(q, k) -> bool:
+    """Shapes the TPU kernel handles: head_dim a lane multiple, sequence
+    lengths divisible by their tile, staged KV within the VMEM budget."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if d % 128 != 0:
+        return False
+    if 2 * sk * d * jnp.dtype(k.dtype).itemsize > _KV_VMEM_BUDGET:
+        return False
+    qt = min(_Q_TILE, sq)
+    kt = min(_KV_TILE, sk)
+    return sq % qt == 0 and sk % kt == 0
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # backend not initialized
+        return False
+
+
+# ---------------------------------------------------------------------------
+# jnp reference path (and CPU fallback)
+# ---------------------------------------------------------------------------
+
+
+def _compute_dtype(q):
+    # At least f32; f64 inputs keep f64 (the x64 test suite's oracles
+    # compare at 1e-12 — the fallback must not down-cast).
+    return jnp.promote_types(q.dtype, jnp.float32)
+
+
+def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
+    ct = _compute_dtype(q)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, ct))
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(ct), k.astype(ct)) * scale
+    if causal:
+        q_pos = q_off.astype(ct) + jnp.arange(sq, dtype=ct)
+        kv_pos = kv_off.astype(ct) + jnp.arange(sk, dtype=ct)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, :, None, :], s, NEG_BIG)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if causal:
+        p = jnp.where(mask[None, :, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(ct))
+    safe_l = jnp.where(l > 0, l, 1.0)
+    out = jnp.where(l[..., None] > 0, acc / safe_l[..., None], 0.0)
+    lse = jnp.where(l > 0, m + jnp.log(safe_l), NEG_BIG)
+    return out.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, causal: bool, kv_tile: int):
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+    qt, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    n_kv = sk // kv_tile
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, f32))
+
+    qb = q_ref[0].astype(f32) * scale                       # (QT, D)
+    qi = pl.program_id(1)
+    q_pos = (qoff_ref[0, 0] + qi * qt
+             + jax.lax.broadcasted_iota(f32, (qt, 1), 0))    # (QT, 1)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * kv_tile, kv_tile), :].astype(f32)
+        vb = v_ref[0, pl.ds(j * kv_tile, kv_tile), :].astype(f32)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=f32)                      # (QT, KT)
+        if causal:
+            kv_pos = (kvoff_ref[0, 0] + j * kv_tile
+                      + jax.lax.broadcasted_iota(f32, (1, kv_tile), 1))
+            mask = q_pos >= kv_pos                           # (QT, KT)
+            s = jnp.where(mask, s, NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
+        return m_new, l, acc
+
+    m0 = jnp.full((qt, 1), NEG_BIG, f32)
+    l0 = jnp.zeros((qt, 1), f32)
+    acc0 = jnp.zeros((qt, d), f32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+
+    nonzero = l > 0
+    safe_l = jnp.where(nonzero, l, 1.0)
+    o_ref[0] = jnp.where(nonzero, acc / safe_l, 0.0).astype(o_ref.dtype)
+    lse = jnp.where(nonzero, m + jnp.log(safe_l), NEG_BIG)
+    lse_ref[0] = lse[:, 0]
+
+
+def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bh = b * h
+    qt = min(_Q_TILE, sq)
+    kt = min(_KV_TILE, sk)
+
+    def to_bh(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(bh, s, d)
+
+    qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
+    qoff = jnp.asarray(q_off, jnp.float32).reshape(1, 1)
+    kvoff = jnp.asarray(kv_off, jnp.float32).reshape(1, 1)
+
+    grid = (bh, sq // qt)
+    smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, kv_tile=kt),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            smem((1, 1), lambda i, j: (0, 0)),
+            smem((1, 1), lambda i, j: (0, 0)),
+            vmem((1, qt, d), lambda i, j: (i, j, 0)),
+            vmem((1, sk, d), lambda i, j: (i, 0, 0)),
+            vmem((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=(
+            vmem((1, qt, d), lambda i, j: (i, j, 0)),
+            vmem((1, qt), lambda i, j: (i, j)),
+        ),
+        interpret=interpret,
+    )(qoff, kvoff, qb, kb, vb)
+
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Differentiable public entry
+# ---------------------------------------------------------------------------
+
+
+def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str):
+    if impl == "jnp":
+        return _jnp_block(q, k, v, q_off, kv_off, causal)
+    if impl == "pallas":
+        if not _eligible(q, k):
+            raise ValueError(
+                f"impl='pallas' requires kernel-eligible shapes "
+                f"(head_dim % 128 == 0, tile-divisible sequence lengths, "
+                f"KV block within the VMEM budget); got q{q.shape} "
+                f"k{k.shape} — use impl='auto' to fall back to jnp")
+        return _pallas_block(q, k, v, q_off, kv_off, causal,
+                             interpret=not _on_tpu())
+    # auto
+    if _eligible(q, k) and _on_tpu():
+        return _pallas_block(q, k, v, q_off, kv_off, causal, interpret=False)
+    return _jnp_block(q, k, v, q_off, kv_off, causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _block(q, k, v, q_off, kv_off, causal: bool, impl: str):
+    return _block_fwd_dispatch(q, k, v, q_off, kv_off, causal, impl)
+
+
+def _block_fwd(q, k, v, q_off, kv_off, causal, impl):
+    out, lse = _block_fwd_dispatch(q, k, v, q_off, kv_off, causal, impl)
+    return (out, lse), (q, k, v, q_off, kv_off, out, lse)
+
+
+def _block_bwd(causal, impl, res, cot):
+    """Flash-style backward by block recomputation (residuals: out + lse;
+    the score matrix is rebuilt, never stored)."""
+    q, k, v, q_off, kv_off, out, lse = res
+    do, dlse = cot
+    f32 = _compute_dtype(q)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, f32))
+    qf, kf, vf = q.astype(f32), k.astype(f32), v.astype(f32)
+    do = do.astype(f32)
+    lse = lse.astype(f32)
+    dlse = dlse.astype(f32)
+    s = jnp.einsum("bqhd,bkhd->bqhk", qf, kf) * scale
+    if causal:
+        q_pos = q_off + jnp.arange(sq, dtype=f32)
+        kv_pos = kv_off + jnp.arange(sk, dtype=f32)
+        mask = (q_pos[:, None] >= kv_pos[None, :])[None, :, None, :]
+        s = jnp.where(mask, s, NEG_BIG)
+    p = jnp.exp(s - lse[..., None])          # = softmax over this block
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    # d p: from out = p @ v  (p already normalized by construction of lse)
+    dp = jnp.einsum("bqhd,bkhd->bqhk", do, vf)
+    dv = jnp.einsum("bqhk,bqhd->bkhd", p, do)
+    delta = jnp.sum(do * out.astype(f32), axis=-1)      # (b, q, h)
+    # lse cotangent: d lse/d s = p, and out depends on lse via -p*out term
+    ds = p * (dp - delta[..., None] + dlse[..., None])
+    dq = jnp.einsum("bqhk,bkhd->bqhd", ds, kf) * scale
+    dk = jnp.einsum("bqhk,bqhd->bkhd", ds, qf) * scale
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros_like(jnp.asarray(q_off, f32)),
+            jnp.zeros_like(jnp.asarray(kv_off, f32)))
+
+
+_block.defvjp(_block_fwd, _block_bwd)
+
+
+def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0.0,
+                          kv_offset=0.0, impl: str = "auto"
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Normalized attention partials of ``q`` against one KV block.
+
+    Args are ``(batch, seq, heads, head_dim)``; offsets are the global
+    positions of the first query/key (may be traced).  Returns
+    ``(out, lse)`` with ``out`` of ``q``'s shape/dtype and ``lse`` of shape
+    ``(batch, seq_q, heads)`` in the compute dtype (f32, or f64 under x64
+    on the jnp path).  ``impl``: ``"auto"`` (Pallas on
+    eligible TPU shapes, else jnp), ``"pallas"`` (forced; interpreted off
+    TPU — for tests), ``"jnp"``."""
+    if impl not in ("auto", "pallas", "jnp"):
+        raise ValueError(f"unknown impl {impl!r}")
+    q_off = jnp.asarray(q_offset, jnp.float32)
+    kv_off = jnp.asarray(kv_offset, jnp.float32)
+    return _block(q, k, v, q_off, kv_off, causal, impl)
+
+
+def merge_partials(out_a, lse_a, out_b, lse_b):
+    """Exact merge of two normalized attention partials over disjoint KV
+    sets — the online-softmax combination rule (associative and, in exact
+    arithmetic, commutative)."""
+    ct = _compute_dtype(out_a)
+    lse = jnp.logaddexp(lse_a, lse_b)
+    wa = jnp.exp(lse_a - lse).astype(ct)[..., None]
+    wb = jnp.exp(lse_b - lse).astype(ct)[..., None]
+    out = out_a.astype(ct) * wa + out_b.astype(ct) * wb
+    return out.astype(out_a.dtype), lse
+
+
+def flash_attention(q, k, v, *, causal: bool = False, impl: str = "auto"):
+    """Single-device fused attention over the full local KV (the
+    non-distributed entry; ``parallel.ring_attention`` composes the block
+    primitive over a mesh axis instead)."""
+    out, _ = flash_block_attention(q, k, v, causal=causal, impl=impl)
+    return out

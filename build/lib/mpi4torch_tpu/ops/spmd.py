@@ -1,0 +1,799 @@
+"""Mode A: SPMD-traced differentiable collectives over a named mesh axis.
+
+This is the TPU performance path: the whole per-rank program is traced once
+under ``jax.shard_map`` over a :class:`jax.sharding.Mesh`, and every
+communication op lowers to the XLA collective that rides ICI/DCN:
+
+    Allreduce(SUM)   -> lax.psum            (self-adjoint custom_vjp)
+    Allreduce(MAX/..)-> lax.pmax/pmin/fold  (backward raises, parity with
+                                             MPIUnimplementedNode)
+    Bcast_/Reduce_   -> masked psum pair    (adjoint pair, like
+                                             csrc/extension.cpp:310-464)
+    Gather/Allgather -> lax.all_gather      (adjoint: lax.psum_scatter —
+                                             a *native* reduce-scatter; the
+                                             mathematically correct Allgather
+                                             adjoint, cf. the reference's
+                                             root=1 quirk at
+                                             csrc/extension.cpp:627)
+    Scatter          -> masked psum + slice (adjoint: all_gather + mask)
+    Alltoall         -> lax.all_to_all      (adjoint: axes-swapped all_to_all,
+                                             csrc/extension.cpp:912)
+    Isend/Irecv/Wait -> lax.ppermute        (matched send/recv pairs fuse
+                                             into ONE collective_permute at
+                                             trace time; adjoint is the
+                                             inverse permutation — the
+                                             reverse-direction gradient ring
+                                             of csrc/extension.cpp:1159-1218,
+                                             compiler-scheduled)
+
+Rank identity is symbolic (:class:`RankExpr`): ``comm.rank`` records affine
+shifts like ``(comm.rank + 1) % comm.size`` so that point-to-point
+destinations stay *static* permutations — XLA cannot permute on a traced
+destination, and the static form is exactly what the TPU ICI torus wants.
+``comm.rank`` materializes to ``lax.axis_index`` when used in arithmetic
+with arrays.
+
+Misuse detectors carried over from the eager runtime, but *at trace time*
+(strictly better than MPI's runtime deadlock): unmatched sends/receives
+raise when the SPMD region closes; double-Wait and spliced handles raise
+immediately (reference guards csrc/extension.cpp:1196-1202, 1231-1237).
+
+The per-rank-varying shard shapes of the eager runtime are impossible under
+single-trace SPMD (XLA static shapes; SURVEY.md §7 hard part 2) — ops here
+require mesh-uniform shapes and raise otherwise; ragged distributions are
+served by the eager runtime or by padding+masking at the user level.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import config as _config
+from .. import constants as C
+from ..runtime import (
+    BifurcationError,
+    CommError,
+    DeadlockError,
+)
+
+# ---------------------------------------------------------------------------
+# Context
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _PendingP2P:
+    kind: str                 # "send" | "recv"
+    shift: int                # sender's destination shift (send) / negated
+                              # source shift (recv) — matched when equal
+    tag: int
+    value: Any                # payload (send) / buffer (recv)
+    handle_state: "_HandleState"
+
+
+@dataclass
+class _HandleState:
+    kind: str                 # "send" | "recv"
+    shift: int
+    tag: int
+    waited: bool = False
+    matched: bool = False
+    loop: Any = None          # loop-through (send)
+    result: Any = None        # ppermute output (recv)
+
+
+@dataclass
+class SpmdContext:
+    """An active SPMD trace region bound to a mesh axis."""
+    axis_name: str
+    size: int
+    pending: List[_PendingP2P] = field(default_factory=list)
+    handles: Dict[int, _HandleState] = field(default_factory=dict)
+
+
+_SPMD_CTX: contextvars.ContextVar[Optional[SpmdContext]] = \
+    contextvars.ContextVar("mpi4torch_tpu_spmd_ctx", default=None)
+
+
+def current_spmd_context() -> Optional[SpmdContext]:
+    return _SPMD_CTX.get()
+
+
+# ---------------------------------------------------------------------------
+# Symbolic rank
+# ---------------------------------------------------------------------------
+
+
+class RankExpr:
+    """Symbolic ``axis_index + offset (mod size)``.
+
+    Keeps ring arithmetic like ``(comm.rank + 1) % comm.size`` *static* so
+    Isend/Irecv destinations lower to a fixed ``collective_permute``
+    schedule.  Any other arithmetic (e.g. ``res * comm.rank``) materializes
+    the traced ``lax.axis_index`` value.
+    """
+
+    __slots__ = ("axis_name", "size", "offset", "wrapped")
+
+    def __init__(self, axis_name: str, size: int, offset: int = 0,
+                 wrapped: bool = False):
+        self.axis_name = axis_name
+        self.size = size
+        # ``wrapped`` records whether the user applied ``% size``; only then
+        # does materialization wrap.  ``comm.rank + 1`` as a plain value is
+        # rank+1 (8 on the last of 8 ranks), NOT (rank+1) % size.
+        self.offset = offset % size if wrapped else offset
+        self.wrapped = wrapped
+
+    # -- static shift algebra ------------------------------------------------
+    def __add__(self, k):
+        if isinstance(k, int) and not self.wrapped:
+            return RankExpr(self.axis_name, self.size, self.offset + k)
+        # Arithmetic past a `% size` is no longer an affine-shift-with-one-
+        # wrap; materialize to the traced value for correctness.
+        return self._materialize() + k
+
+    __radd__ = __add__
+
+    def __sub__(self, k):
+        if isinstance(k, int) and not self.wrapped:
+            return RankExpr(self.axis_name, self.size, self.offset - k)
+        return self._materialize() - k
+
+    def __mod__(self, m):
+        if isinstance(m, int) and m == self.size:
+            return RankExpr(self.axis_name, self.size, self.offset,
+                            wrapped=True)
+        return self._materialize() % m
+
+    # -- materialization -----------------------------------------------------
+    def _materialize(self):
+        idx = lax.axis_index(self.axis_name)
+        if self.offset:
+            out = idx + self.offset
+            return out % self.size if self.wrapped else out
+        return idx
+
+    def __jax_array__(self):
+        return self._materialize()
+
+    def __mul__(self, other):
+        return self._materialize() * other
+
+    __rmul__ = __mul__
+
+    def __rsub__(self, other):
+        return other - self._materialize()
+
+    def __eq__(self, other):
+        if isinstance(other, RankExpr):
+            return (self.axis_name == other.axis_name
+                    and self.size == other.size
+                    and self.offset == other.offset
+                    and self.wrapped == other.wrapped)
+        return self._materialize() == other
+
+    def __hash__(self):
+        return hash((self.axis_name, self.size, self.offset, self.wrapped))
+
+    def __int__(self):
+        raise CommError(
+            "comm.rank is symbolic under SPMD tracing (one trace for all "
+            "ranks); it cannot be converted to a Python int.  Use it in "
+            "array arithmetic (it materializes to lax.axis_index) or in "
+            "ring shifts like (comm.rank + 1) % comm.size for p2p "
+            "destinations.  For concrete Python ranks use the eager "
+            "thread-SPMD runtime (run_ranks)."
+        )
+
+    __index__ = __int__
+
+    def __repr__(self):
+        return f"RankExpr({self.axis_name!r}, size={self.size}, offset={self.offset})"
+
+
+def _rank_shift(ctx: SpmdContext, peer, what: str) -> int:
+    """Resolve a p2p peer to a static ring shift relative to the local rank."""
+    if isinstance(peer, RankExpr):
+        if peer.axis_name != ctx.axis_name:
+            raise CommError(
+                f"{what} rank belongs to axis {peer.axis_name!r}, not the "
+                f"communicator's axis {ctx.axis_name!r}"
+            )
+        if not peer.wrapped and peer.offset != 0:
+            # `comm.rank + k` without `% size` is out of [0, size) on some
+            # rank — MPI would reject it there; under a single trace we
+            # reject it everywhere instead of silently wrapping.
+            raise CommError(
+                f"{what} rank `comm.rank {peer.offset:+d}` is out of range "
+                f"on some ranks (size {ctx.size}); write "
+                f"`(comm.rank {peer.offset:+d}) % comm.size` for a ring "
+                "shift"
+            )
+        return peer.offset % ctx.size
+    raise CommError(
+        f"Under SPMD tracing, the {what} of a point-to-point op must be a "
+        "static ring shift of comm.rank (e.g. (comm.rank + 1) % comm.size); "
+        f"got {peer!r}.  A literal rank would mean every rank sends to the "
+        "same destination, which is not a permutation.  Use the eager "
+        "thread-SPMD runtime for arbitrary concrete destinations."
+    )
+
+
+# ---------------------------------------------------------------------------
+# Collectives
+# ---------------------------------------------------------------------------
+
+
+def _ordered_fold_allreduce(ctx: SpmdContext, x, op: int):
+    """All-gather + fixed ascending-rank fold: deterministic, bit-identical
+    to the eager (MPI-linear-order) oracle.  Used for ops with no native XLA
+    collective and, under config.deterministic_reductions(), for SUM."""
+    stacked = lax.all_gather(x, ctx.axis_name, axis=0, tiled=False)
+    out = stacked[0]
+    for i in range(1, ctx.size):
+        out = C.combine2(op, out, stacked[i])
+    return out
+
+
+def _allreduce_fwd_value(ctx: SpmdContext, x, op: int):
+    if op == C.MPI_SUM:
+        if _config.deterministic_reductions():
+            return _ordered_fold_allreduce(ctx, x, op)
+        return lax.psum(x, ctx.axis_name)
+    if op == C.MPI_MAX:
+        return lax.pmax(x, ctx.axis_name)
+    if op == C.MPI_MIN:
+        return lax.pmin(x, ctx.axis_name)
+    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+        C.combine2(op, x, x)  # raises NotImplementedError with explanation
+    return _ordered_fold_allreduce(ctx, x, op)
+
+
+def allreduce(ctx: SpmdContext, x, op: int):
+    """SPMD Allreduce (reference: csrc/extension.cpp:274-308).  SUM lowers
+    to ``lax.psum`` (self-adjoint); other ops' backward raises, matching
+    MPIUnimplementedNode (csrc/extension.cpp:194-202)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return _allreduce_fwd_value(ctx, v, op)
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Allreduce with {C.op_name(op)} is not "
+                "implemented — only MPI_SUM is differentiable (reference: "
+                "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
+            )
+        return (_allreduce_fwd_value(ctx, g, C.MPI_SUM),)
+
+    f.defvjp(lambda v: (_allreduce_fwd_value(ctx, v, op), None), bwd)
+    return f(x)
+
+
+def _mask_to_root(ctx: SpmdContext, x, root: int):
+    idx = lax.axis_index(ctx.axis_name)
+    return jnp.where(idx == root, x, jnp.zeros_like(x))
+
+
+def _bcast_value(ctx: SpmdContext, x, root: int):
+    # XLA has no broadcast collective; a root-masked psum is the standard
+    # lowering (compiles to an efficient broadcast on the ICI torus).
+    return lax.psum(_mask_to_root(ctx, x, root), ctx.axis_name)
+
+
+def _reduce_value(ctx: SpmdContext, x, op: int, root: int):
+    red = _allreduce_fwd_value(ctx, x, op)
+    # Non-root results are zeroed (reference: csrc/extension.cpp:443-447).
+    return _mask_to_root(ctx, red, root)
+
+
+def bcast_(ctx: SpmdContext, x, root: int):
+    """SPMD broadcast (reference: csrc/extension.cpp:333-365); adjoint is
+    Reduce_(SUM, root) (csrc/extension.cpp:310-331)."""
+    _check_root(ctx, root)
+
+    @jax.custom_vjp
+    def f(v):
+        return _bcast_value(ctx, v, root)
+
+    f.defvjp(lambda v: (_bcast_value(ctx, v, root), None),
+             lambda _, g: (_reduce_value(ctx, g, C.MPI_SUM, root),))
+    return f(x)
+
+
+def reduce_(ctx: SpmdContext, x, op: int, root: int):
+    """SPMD reduce-to-root with zeroed non-root results (reference:
+    csrc/extension.cpp:405-464); adjoint is Bcast_(root); only SUM
+    differentiable."""
+    _check_root(ctx, root)
+
+    @jax.custom_vjp
+    def f(v):
+        return _reduce_value(ctx, v, op, root)
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Reduce_ with {C.op_name(op)} is not "
+                "implemented — only MPI_SUM is differentiable (reference: "
+                "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
+            )
+        return (_bcast_value(ctx, g, root),)
+
+    f.defvjp(lambda v: (_reduce_value(ctx, v, op, root), None), bwd)
+    return f(x)
+
+
+from .eager import _norm_axis  # shared axis normalization
+
+
+def allgather(ctx: SpmdContext, x, gatheraxis: int):
+    """SPMD allgather along an arbitrary axis (reference:
+    csrc/extension.cpp:633-734).  Adjoint: ``lax.psum_scatter`` — the
+    native TPU reduce-scatter, which is the mathematically correct adjoint
+    (the reference's backward has the constant-root quirk at
+    csrc/extension.cpp:627; see ops/eager.py docstring)."""
+    ax = _norm_axis(gatheraxis, jnp.ndim(x))
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.all_gather(v, ctx.axis_name, axis=ax, tiled=True)
+
+    def bwd(_, g):
+        return (lax.psum_scatter(g, ctx.axis_name, scatter_dimension=ax,
+                                 tiled=True),)
+
+    f.defvjp(lambda v: (lax.all_gather(v, ctx.axis_name, axis=ax, tiled=True),
+                        None), bwd)
+    return f(x)
+
+
+def gather(ctx: SpmdContext, x, gatheraxis: int, root: int):
+    """SPMD gather-to-root (reference: csrc/extension.cpp:497-599): an
+    all-gather with non-root results zeroed (the reference's non-root
+    outputs are undefined; zeros are the well-defined superset).  Adjoint:
+    the root's gradient is scattered back — here a root-masked psum_scatter.
+    """
+    _check_root(ctx, root)
+    ax = _norm_axis(gatheraxis, jnp.ndim(x))
+
+    def fwd_value(v):
+        full = lax.all_gather(v, ctx.axis_name, axis=ax, tiled=True)
+        return _mask_to_root(ctx, full, root)
+
+    @jax.custom_vjp
+    def f(v):
+        return fwd_value(v)
+
+    def bwd(_, g):
+        # Only the root's upstream gradient is real (non-root forward
+        # outputs are zeros); one root-masked psum_scatter delivers each
+        # rank its segment of it — Scatter(grad, ax, numelem, root),
+        # csrc/extension.cpp:466-495.
+        return (lax.psum_scatter(_mask_to_root(ctx, g, root), ctx.axis_name,
+                                 scatter_dimension=ax, tiled=True),)
+
+    f.defvjp(lambda v: (fwd_value(v), None), bwd)
+    return f(x)
+
+
+def scatter(ctx: SpmdContext, x, scatteraxis: int, numelem: int, root: int):
+    """SPMD scatter-from-root (reference: csrc/extension.cpp:769-884).
+
+    Under single-trace SPMD all ranks pass same-shaped inputs and segments
+    are equal-sized; ``numelem`` must equal ``axis_len // size`` (the eager
+    runtime serves per-rank-varying ``numelem``).  The root's data wins
+    (non-root inputs ignored, csrc/extension.cpp:788-796) — implemented as
+    a root-masked psum (broadcast) followed by a static per-rank slice.
+    Adjoint: Gather(grad, scatteraxis, root) (csrc/extension.cpp:736-767).
+    """
+    _check_root(ctx, root)
+    ax = _norm_axis(scatteraxis, jnp.ndim(x))
+    axlen = x.shape[ax]
+    if axlen % ctx.size != 0 or numelem != axlen // ctx.size:
+        raise ValueError(
+            f"Scatter under SPMD requires numelem ({numelem}) == axis length "
+            f"({axlen}) // mesh size ({ctx.size}); per-rank-varying segments "
+            "need the eager runtime (SURVEY.md §7 hard part 2)"
+        )
+
+    def fwd_value(v):
+        # Root-masked psum_scatter: ONE native reduce-scatter collective
+        # delivers each rank exactly its segment of the root's tensor —
+        # 1/N the bandwidth of broadcast-then-slice.
+        return lax.psum_scatter(_mask_to_root(ctx, v, root), ctx.axis_name,
+                                scatter_dimension=ax, tiled=True)
+
+    @jax.custom_vjp
+    def f(v):
+        return fwd_value(v)
+
+    def bwd(_, g):
+        full = lax.all_gather(g, ctx.axis_name, axis=ax, tiled=True)
+        # Gradient is real only on root (non-root inputs were ignored);
+        # keep the collective in every rank's program (the moral of the
+        # reference's JoinDummies(zeros, {gather}) trick,
+        # csrc/extension.cpp:756-766) and mask.
+        return (_mask_to_root(ctx, full, root),)
+
+    f.defvjp(lambda v: (fwd_value(v), None), bwd)
+    return f(x)
+
+
+def alltoall(ctx: SpmdContext, x, gatheraxis: int, scatteraxis: int,
+             numelem: int):
+    """SPMD all-to-all (reference: csrc/extension.cpp:917-987, there a loop
+    of Scatters): lowers to the single native ``lax.all_to_all`` collective —
+    split the local block along ``scatteraxis``, exchange, concatenate along
+    ``gatheraxis``.  Adjoint: the axes-swapped all-to-all
+    (csrc/extension.cpp:886-915)."""
+    ga = _norm_axis(gatheraxis, jnp.ndim(x))
+    sa = _norm_axis(scatteraxis, jnp.ndim(x))
+    axlen = x.shape[sa]
+    if axlen % ctx.size != 0 or numelem != axlen // ctx.size:
+        raise ValueError(
+            f"Alltoall under SPMD requires numelem ({numelem}) == scatter "
+            f"axis length ({axlen}) // mesh size ({ctx.size}); "
+            "per-rank-varying segments need the eager runtime"
+        )
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.all_to_all(v, ctx.axis_name, split_axis=sa,
+                              concat_axis=ga, tiled=True)
+
+    def bwd(_, g):
+        return (lax.all_to_all(g, ctx.axis_name, split_axis=ga,
+                               concat_axis=sa, tiled=True),)
+
+    f.defvjp(lambda v: (lax.all_to_all(v, ctx.axis_name, split_axis=sa,
+                                       concat_axis=ga, tiled=True), None),
+             bwd)
+    return f(x)
+
+
+def _check_root(ctx: SpmdContext, root: int) -> None:
+    if not (0 <= root < ctx.size):
+        raise CommError(f"invalid root rank {root} (axis size {ctx.size})")
+
+
+# ---------------------------------------------------------------------------
+# Dependency tokens
+# ---------------------------------------------------------------------------
+
+
+def join_dummies(loopthrough, dummies):
+    """Same construction as the eager implementation — an
+    ``optimization_barrier``-tied identity with zero-but-ordered cotangents
+    — which is already trace-compatible (see ops/eager.py:join_dummies and
+    reference csrc/extension.cpp:989-1046)."""
+    from .eager import join_dummies as _jd
+    return _jd(loopthrough, dummies)
+
+
+# ---------------------------------------------------------------------------
+# Point-to-point: Isend / Irecv / Wait via matched collective_permute
+# ---------------------------------------------------------------------------
+
+
+def _perm_for_shift(size: int, shift: int) -> List[Tuple[int, int]]:
+    return [(i, (i + shift) % size) for i in range(size)]
+
+
+def _emit_permute(ctx: SpmdContext, value, shift: int):
+    return lax.ppermute(value, ctx.axis_name,
+                        perm=_perm_for_shift(ctx.size, shift))
+
+
+def _try_match(ctx: SpmdContext) -> None:
+    """Pair pending sends with pending recvs of the same tag and
+    complementary shift; each pair fuses into one collective_permute whose
+    output is stored on the recv handle."""
+    sends = [p for p in ctx.pending if p.kind == "send"]
+    recvs = [p for p in ctx.pending if p.kind == "recv"]
+    for s in sends:
+        for r in recvs:
+            if s.tag == r.tag and s.shift == r.shift:
+                if (tuple(s.value.shape) != tuple(r.value.shape)
+                        or s.value.dtype != r.value.dtype):
+                    raise CommError(
+                        f"matched Isend/Irecv on tag {s.tag} disagree on "
+                        f"shape/dtype: send {s.value.shape}/{s.value.dtype} "
+                        f"vs recv buffer {r.value.shape}/{r.value.dtype}"
+                    )
+                y = _emit_permute(ctx, s.value, s.shift)
+                r.handle_state.result = y
+                r.handle_state.matched = True
+                s.handle_state.matched = True
+                ctx.pending.remove(s)
+                ctx.pending.remove(r)
+                return _try_match(ctx)
+
+
+def _fresh(x):
+    """Pass through an optimization barrier to obtain a unique tracer
+    object — the handle identity key (the analogue of the reference's
+    buffer-pointer hash, csrc/extension.cpp:1100)."""
+    return lax.optimization_barrier(x)
+
+
+_SPMD_DESC_LEN = 8
+
+
+def isend(ctx: SpmdContext, x, dest, tag: int) -> List:
+    """SPMD nonblocking send (reference: csrc/extension.cpp:1071-1113).
+
+    ``dest`` must be a static ring shift of ``comm.rank``.  The actual
+    transfer is emitted as a ``collective_permute`` the moment the matching
+    Irecv appears in the trace; XLA schedules the start/done pair
+    asynchronously — the compiler plays the role of MPI_Isend/MPI_Wait.
+    Returns the raw 3-tensor handle [descriptor, buffer, loopthrough]."""
+    shift = _rank_shift(ctx, dest, "destination")
+    if shift == 0:
+        raise CommError("Isend to self (shift 0) is not a permutation")
+    buf = _fresh(x)
+    desc = lax.optimization_barrier(
+        (jnp.zeros(_SPMD_DESC_LEN, jnp.float32), buf))[0]
+    state = _HandleState(kind="send", shift=shift, tag=tag, loop=buf)
+    ctx.handles[id(buf)] = state
+    ctx.pending.append(_PendingP2P("send", shift, tag, x, state))
+    _try_match(ctx)
+    return [desc, buf, buf]
+
+
+def irecv(ctx: SpmdContext, x, source, tag: int) -> List:
+    """SPMD nonblocking receive (reference: csrc/extension.cpp:1115-1157).
+    ``source`` must be a static ring shift of ``comm.rank``; a source shift
+    of ``-k`` matches sends with destination shift ``+k``."""
+    src_shift = _rank_shift(ctx, source, "source")
+    if src_shift == 0:
+        raise CommError("Irecv from self (shift 0) is not a permutation")
+    send_shift = (-src_shift) % ctx.size
+    buf = _fresh(x)
+    desc = lax.optimization_barrier(
+        (jnp.zeros(_SPMD_DESC_LEN, jnp.float32), buf))[0]
+    state = _HandleState(kind="recv", shift=send_shift, tag=tag)
+    ctx.handles[id(buf)] = state
+    ctx.pending.append(_PendingP2P("recv", send_shift, tag, buf, state))
+    _try_match(ctx)
+    return [desc, buf, buf]
+
+
+def wait(ctx: SpmdContext, handle: List):
+    """SPMD Wait (reference: csrc/extension.cpp:1220-1265).
+
+    Completion is a trace-level event: for a recv handle, returns the
+    matched permute's output (gradients flow through the permute's own
+    adjoint — the reverse-direction ring); for a send handle, returns the
+    loop-through.  Guards: unknown/spliced handles and double waits raise
+    (csrc/extension.cpp:1196-1202, 1231-1237); an unmatched handle raises a
+    trace-time DeadlockError — strictly earlier than MPI's runtime hang."""
+    desc, buf, loop = handle
+    state = ctx.handles.get(id(buf))
+    if state is None:
+        raise BifurcationError(
+            "Detected bifurcation in Wait handle usage: this handle's buffer "
+            "does not belong to any posted request in the active SPMD region "
+            "(handles must not be rebuilt from parts of other handles; "
+            "reference guard csrc/extension.cpp:1231-1237)"
+        )
+    if state.waited:
+        raise BifurcationError(
+            "Detected bifurcation in Wait handle usage: this request was "
+            "already waited on (a WaitHandle completes exactly once)"
+        )
+    state.waited = True
+    if state.kind == "send":
+        # A send may be waited on before its matching Irecv appears later
+        # in the program (e.g. blocking Send = Isend+Wait): completion of a
+        # buffered send is local.  The permute is emitted when the match
+        # arrives; a send that never matches is caught at region close.
+        # Tie the returned loop-through to the descriptor chain so
+        # JoinDummiesHandle ordering survives into the compiled program.
+        return lax.optimization_barrier((loop, desc))[0]
+    if not state.matched:
+        raise DeadlockError(
+            f"trace-time deadlock: Wait on a receive (tag {state.tag}, ring "
+            f"shift {state.shift}) before the matching Isend appears in the "
+            "program.  Under single-trace SPMD every rank runs the same "
+            "program, so a blocking Recv with no prior matching send means "
+            "ALL ranks block in Recv — a real deadlock under MPI too.  Post "
+            "the Isend first (Isend -> Recv -> Wait, as in the reference "
+            "examples), or use Irecv and delay the Wait past the send."
+        )
+    return lax.optimization_barrier((state.result, desc))[0]
+
+
+# ---------------------------------------------------------------------------
+# Backend + harness
+# ---------------------------------------------------------------------------
+
+
+class SpmdBackend:
+    """Binds the facade op table to an active SPMD trace context."""
+
+    def __init__(self, ctx: SpmdContext):
+        self._ctx = ctx
+
+    @property
+    def rank(self) -> RankExpr:
+        return RankExpr(self._ctx.axis_name, self._ctx.size)
+
+    @property
+    def size(self) -> int:
+        return self._ctx.size
+
+    def allreduce(self, x, op):
+        return allreduce(self._ctx, x, op)
+
+    def bcast_(self, x, root):
+        return bcast_(self._ctx, x, root)
+
+    def reduce_(self, x, op, root):
+        return reduce_(self._ctx, x, op, root)
+
+    def gather(self, x, gatheraxis, root):
+        return gather(self._ctx, x, gatheraxis, root)
+
+    def allgather(self, x, gatheraxis):
+        return allgather(self._ctx, x, gatheraxis)
+
+    def scatter(self, x, scatteraxis, numelem, root):
+        return scatter(self._ctx, x, scatteraxis, numelem, root)
+
+    def alltoall(self, x, gatheraxis, scatteraxis, numelem):
+        return alltoall(self._ctx, x, gatheraxis, scatteraxis, numelem)
+
+    def isend(self, x, dest, tag):
+        return isend(self._ctx, x, dest, tag)
+
+    def irecv(self, x, source, tag):
+        return irecv(self._ctx, x, source, tag)
+
+    def wait(self, handle):
+        return wait(self._ctx, handle)
+
+
+class _bind_spmd:
+    def __init__(self, ctx: SpmdContext):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.token = _SPMD_CTX.set(self.ctx)
+        return self.ctx
+
+    def __exit__(self, exc_type, *rest):
+        _SPMD_CTX.reset(self.token)
+        if exc_type is None and self.ctx.pending:
+            leftover = ", ".join(
+                f"{p.kind}(tag={p.tag}, shift={p.shift})"
+                for p in self.ctx.pending
+            )
+            raise DeadlockError(
+                f"trace-time deadlock: unmatched point-to-point operations "
+                f"at the end of the SPMD region: {leftover} — every Isend "
+                "needs a complementary Irecv with the same tag (under MPI "
+                "this program would hang)"
+            )
+        return False
+
+
+def comm_from_mesh(mesh, axis_name: str):
+    """Adopt a mesh axis as a communicator for use inside the caller's own
+    ``shard_map``/``pjit`` region — the TPU-native analogue of the
+    reference's foreign-communicator interop (csrc/extension.cpp:168-171,
+    src/__init__.py:247-261)."""
+    from ..comm import MPI_Communicator
+
+    if axis_name not in mesh.axis_names:
+        raise CommError(
+            f"axis {axis_name!r} not in mesh axes {mesh.axis_names}"
+        )
+    size = mesh.shape[axis_name]
+
+    # One shared SpmdContext per trace region, so Isend/Irecv posted by
+    # different op calls inside the same user-managed shard_map can match
+    # into a collective_permute.  Keyed weakly on the active trace object:
+    # entries die with their trace, and tracer-id handle state can never
+    # leak across traces.
+    import weakref
+    trace_contexts: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    def _warn_if_pending(ctx: SpmdContext):
+        # A user-managed shard_map region has no exit hook where we could
+        # raise (run_spmd does); when the trace dies with unmatched p2p ops
+        # we cannot throw from a finalizer, so emit a loud warning instead.
+        if ctx.pending:
+            import sys
+            leftover = ", ".join(
+                f"{p.kind}(tag={p.tag}, shift={p.shift})" for p in ctx.pending
+            )
+            print(
+                "mpi4torch_tpu WARNING: SPMD trace region ended with "
+                f"unmatched point-to-point operations: {leftover} — the "
+                "message was silently dropped; every Isend needs a "
+                "complementary Irecv with the same tag (under MPI this "
+                "program would hang)",
+                file=sys.stderr,
+            )
+
+    def resolver():
+        ctx = current_spmd_context()
+        if ctx is not None and ctx.axis_name == axis_name:
+            return SpmdBackend(ctx)
+        from jax._src.core import trace_ctx
+        trace = trace_ctx.trace
+        ctx = trace_contexts.get(trace)
+        if ctx is None:
+            ctx = SpmdContext(axis_name=axis_name, size=size)
+            try:
+                trace_contexts[trace] = ctx
+                import weakref as _wr
+                _wr.finalize(trace, _warn_if_pending, ctx)
+            except TypeError:
+                pass  # non-weakrefable trace: fall back to per-call context
+        return SpmdBackend(ctx)
+
+    return MPI_Communicator(resolver)
+
+
+DEFAULT_AXIS = "mpi"
+
+
+def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
+             axis_name: str = DEFAULT_AXIS, jit: bool = True):
+    """Run ``fn`` SPMD over a mesh axis — the traced/compiled counterpart of
+    :func:`mpi4torch_tpu.run_ranks`.
+
+    ``fn(*args)`` is traced ONCE for all ranks (inputs replicated to every
+    rank; derive rank-local data from ``COMM_WORLD.rank``).  Each of its
+    outputs gains a leading ``nranks`` axis holding the per-rank results.
+    Differentiable end-to-end: ``jax.grad`` of (a reduction of) the stacked
+    outputs sums cotangents over ranks, exactly like executing ``backward()``
+    on every MPI rank (SURVEY.md §3.3).
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    if mesh is None:
+        devs = jax.devices()
+        n = nranks or len(devs)
+        if n > len(devs):
+            raise CommError(
+                f"requested {n} ranks but only {len(devs)} devices are "
+                "available (set --xla_force_host_platform_device_count)"
+            )
+        import numpy as np
+        mesh = Mesh(np.asarray(devs[:n]), (axis_name,))
+    size = mesh.shape[axis_name]
+
+    def wrapped(det, *args):
+        ctx = SpmdContext(axis_name=axis_name, size=size)
+        with _bind_spmd(ctx), _config.deterministic_mode(det):
+            out = fn(*args)
+        return jax.tree.map(lambda y: jnp.expand_dims(y, 0), out)
+
+    def sm(det, *args):
+        return shard_map(lambda *a: wrapped(det, *a), mesh=mesh, in_specs=P(),
+                         out_specs=P(axis_name), check_vma=False)(*args)
+
+    if jit:
+        jitted = jax.jit(sm, static_argnums=0)
+    else:
+        jitted = sm
+
+    def call(*args):
+        # The deterministic-reductions flag is read at *call* time and made
+        # part of the jit cache key (static arg), so toggling it after the
+        # first call retraces instead of silently reusing the old lowering.
+        return jitted(_config.deterministic_reductions(), *args)
+
+    return call

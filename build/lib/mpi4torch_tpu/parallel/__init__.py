@@ -1,0 +1,63 @@
+"""Parallelism strategies built on the differentiable op surface.
+
+The reference ships the *primitives* for every strategy but no strategy
+engines (SURVEY.md §2.5): its docs demonstrate DP, its axis-aware
+Gather/Scatter are the TP glue, its Isend/Irecv ring is the CP transport,
+and its Alltoall is the Ulysses SP reshuffle.  This package provides those
+strategies as first-class, AD-transparent library code — every distributed
+movement goes through the ``MPI_Communicator`` op table, so each strategy
+runs unchanged on the eager thread-SPMD runtime (concrete ranks, the
+``mpirun`` analogue) and on the SPMD mesh backend (XLA collectives over
+ICI/DCN).
+
+    dp         — data parallelism (the reference's two-Allreduce recipe)
+    ring       — differentiable ring shifts and halo exchange (Isend/Irecv)
+    attention  — long-context attention: ring attention (CP) and Ulysses
+                 all-to-all head/sequence attention (SP)
+    tp         — tensor parallelism: column/row-parallel layers
+    moe        — expert parallelism: capacity-based MoE over Alltoall
+    pp         — pipeline parallelism: GPipe fill-drain over Isend/Irecv
+"""
+
+from . import attention, dp, moe, pp, ring, tp
+
+from .dp import all_average_tree, dp_value_and_grad
+from .ring import halo_exchange, ring_shift
+from .attention import dense_attention, ring_attention, ulysses_attention
+from .tp import (
+    column_parallel_linear,
+    row_parallel_linear,
+    shard_axis,
+    tp_attention,
+    tp_mlp,
+)
+from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
+from .pp import pipeline_spmd, pipeline_step, recv_activation, send_activation
+
+__all__ = [
+    "attention",
+    "dp",
+    "moe",
+    "ring",
+    "tp",
+    "all_average_tree",
+    "dp_value_and_grad",
+    "halo_exchange",
+    "ring_shift",
+    "dense_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "column_parallel_linear",
+    "row_parallel_linear",
+    "shard_axis",
+    "tp_attention",
+    "tp_mlp",
+    "init_moe",
+    "moe_ffn",
+    "moe_ffn_dense",
+    "top1_route",
+    "pipeline_spmd",
+    "pipeline_step",
+    "recv_activation",
+    "send_activation",
+]

@@ -1,0 +1,126 @@
+"""Long-context attention: ring attention (CP) and Ulysses (SP).
+
+The reference contains no attention algorithms, but its op table is exactly
+the enabling primitive set (SURVEY.md §2.5): the differentiable Isend/Irecv
+ring is the ring-attention transport, and axis-generic ``Alltoall`` with
+``gatheraxis != scatteraxis`` *is* the Ulysses head<->sequence reshuffle
+(reference: csrc/extension.cpp:917-987, 1071-1157).  This module builds both
+algorithms purely from the communicator op surface, so they are
+AD-transparent on either backend; under the SPMD mesh backend the transport
+lowers to ``collective_permute`` / ``all_to_all`` over ICI.
+
+Conventions: tensors are ``(batch, seq, heads, head_dim)``; each rank holds
+a contiguous equal shard of the sequence axis in rank order.  Compute per
+block is batched matmul (MXU-shaped); the ring loop is a static Python loop
+over ``comm.size`` (trace-unrolled: each iteration's permute can overlap
+the next block's compute under XLA's async collective scheduling).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ring import ring_shift
+
+_NEG_BIG = -1e30  # finite mask value: keeps exp/grad NaN-free (vs -inf)
+
+
+def _causal_bias(q_pos, kv_pos, dtype):
+    mask = q_pos[:, None] >= kv_pos[None, :]
+    return jnp.where(mask, jnp.zeros([], dtype), jnp.asarray(_NEG_BIG, dtype))
+
+
+def dense_attention(q, k, v, causal: bool = False, q_offset=0, kv_offset=0):
+    """Reference single-device scaled-dot-product attention.
+
+    ``q_offset``/``kv_offset`` are the global positions of the first query/
+    key, so shards of a longer sequence mask correctly."""
+    dtype = q.dtype
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype))
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        scores = scores + _causal_bias(q_pos, kv_pos, dtype)[:, None, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
+                   impl: str = "auto"):
+    """Blockwise ring attention over the sequence axis (context parallel).
+
+    Each rank holds one sequence block of q/k/v.  K/V blocks circulate the
+    ring; the local result accumulates by merging normalized block
+    partials (``(out, lse)`` online-softmax combination), so it equals
+    dense attention over the full sequence without any rank ever
+    materializing it — O(seq/ranks) memory per rank.  Gradients ride the
+    reverse ring automatically (the transport is the differentiable
+    ``ring_shift``).
+
+    The per-block compute is :func:`~mpi4torch_tpu.ops.flash.
+    flash_block_attention`: on eligible TPU shapes the fused Pallas kernel
+    (scores never hit HBM), otherwise the jnp path; ``impl`` forces a
+    path (tests pin both against the dense oracle).
+    """
+    from ..ops.flash import flash_block_attention, merge_partials
+
+    size = comm.size
+    b, s_local, h, d = q.shape
+
+    # Global block positions: rank may be symbolic (lax.axis_index) under
+    # SPMD tracing; all masking is array arithmetic (SURVEY.md §7 hard
+    # part 4 — rank-dependent values under a single trace).
+    my_rank = jnp.asarray(comm.rank)
+    q_off = my_rank * s_local
+
+    out = None
+    lse = None
+    for step in range(size):
+        # After `step` +1-shifts the local K/V block originated on rank
+        # (my_rank - step) % size.
+        owner = (my_rank - step) % size
+        o_b, lse_b = flash_block_attention(
+            q, k, v, causal=causal, q_offset=q_off,
+            kv_offset=owner * s_local, impl=impl)
+        if out is None:
+            out, lse = o_b, lse_b
+        else:
+            out, lse = merge_partials(out, lse, o_b, lse_b)
+
+        if step + 1 < size:
+            k = ring_shift(comm, k, 1, tag + 2 * step)
+            v = ring_shift(comm, v, 1, tag + 2 * step + 1)
+
+    return out
+
+
+def ulysses_attention(comm, q, k, v, causal: bool = False):
+    """Ulysses sequence parallelism: all-to-all head<->sequence reshuffle.
+
+    Each rank trades its sequence shard of ALL heads for the FULL sequence
+    of ``heads/size`` heads (one ``Alltoall`` per tensor — the exact
+    exchange the reference's axis-generic Alltoall was built for), runs
+    dense attention on its head group, and reshuffles back.  Requires
+    ``heads % size == 0``."""
+    size = comm.size
+    b, s_local, h, d = q.shape
+    if h % size != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"communicator size ({size})")
+    h_local = h // size
+
+    def to_heads(x):
+        # (b, s_local, h, d) -> (b, s_global, h/size, d)
+        return comm.Alltoall(x, gatheraxis=1, scatteraxis=2,
+                             numelem=h_local)
+
+    def to_seq(x):
+        return comm.Alltoall(x, gatheraxis=2, scatteraxis=1,
+                             numelem=s_local)
+
+    out = dense_attention(to_heads(q), to_heads(k), to_heads(v),
+                          causal=causal)
+    return to_seq(out)

@@ -1,0 +1,151 @@
+"""Expert parallelism: capacity-based MoE dispatch over ``Alltoall``.
+
+The reference has no MoE, but its ``Alltoall`` with per-rank-varying
+``numelem`` is exactly the token-dispatch primitive (SURVEY.md §2.5 EP row;
+reference: csrc/extension.cpp:947-979).  XLA wants static shapes, so the
+ragged dispatch becomes the standard padded+masked *capacity* formulation
+(SURVEY.md §7 hard part 2): every expert receives a fixed ``capacity`` slot
+buffer per source rank, tokens beyond capacity are dropped (zero
+contribution — route them through the residual connection), and the ragged
+structure lives in the dispatch/combine masks, not the shapes.
+
+Layout (experts rank-major: expert ``e`` lives on rank ``e // epr``):
+
+    tokens (T, d) --top-1 router--> dispatch one-hot (T, E, C)
+    send   (size, epr*C, d)   --Alltoall(ga=1, sa=0)-->  recv from all ranks
+    expert FFN on (epr, size*C, d)   (batched einsum — MXU-shaped)
+    return Alltoall (the same exchange; its adjoint is itself) --combine-->
+
+Both transports are the one differentiable ``Alltoall`` op, so the entire
+MoE layer is AD-transparent on either backend; gradients to expert weights
+ride the reverse all-to-all automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_route(router_logits, capacity: int):
+    """Switch-style top-1 routing with a per-expert capacity.
+
+    Returns ``(dispatch, combine, aux)``: a ``(T, E, C)`` boolean dispatch
+    mask (token t occupies slot c of expert e), the same mask scaled by the
+    router probability (the combine weights), and the load-balancing
+    auxiliary loss ``E * sum_e f_e * P_e`` (Switch Transformer's; equals 1
+    at perfect balance)."""
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # (T,)
+    gate = jnp.max(probs, axis=-1)                            # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=probs.dtype)     # (T, E)
+
+    # Slot index of each token within its expert's buffer, in token order.
+    pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based
+    pos = jnp.sum(pos, axis=-1) - 1.0                         # (T,)
+    keep = pos < capacity
+
+    slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1).astype(jnp.int32),
+                          capacity, dtype=probs.dtype)        # (T, C)
+    dispatch = (onehot[:, :, None] * slot[:, None, :]
+                * keep[:, None, None].astype(probs.dtype))    # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+
+    frac = jnp.mean(onehot, axis=0)                           # f_e
+    mean_prob = jnp.mean(probs, axis=0)                       # P_e
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def init_moe(key, n_experts: int, d_model: int, d_ff: int,
+             dtype=jnp.float32) -> Dict[str, Any]:
+    """Replicated parameter pytree for a MoE FFN with stacked expert weights
+    (experts on axis 0, rank-major); each rank slices its shard with
+    :func:`~mpi4torch_tpu.parallel.tp.shard_axis` inside :func:`moe_ffn`."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(jnp.asarray(d_model, dtype))
+    scale_out = 1.0 / jnp.sqrt(jnp.asarray(d_ff, dtype))
+    return {
+        "gate": jax.random.normal(kg, (d_model, n_experts), dtype) * scale_in,
+        "w1": jax.random.normal(k1, (n_experts, d_model, d_ff), dtype) * scale_in,
+        "b1": jnp.zeros((n_experts, d_ff), dtype),
+        "w2": jax.random.normal(k2, (n_experts, d_ff, d_model), dtype) * scale_out,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def moe_ffn(comm, x, params: Dict[str, Any], capacity: int,
+            activation=jax.nn.gelu):
+    """Expert-parallel MoE FFN layer.
+
+    ``x`` is this rank's ``(T, d)`` token shard; ``params`` is the
+    *replicated* stacked-expert pytree from :func:`init_moe` (so the DP
+    param-averaging recipe applies unchanged) — each rank computes only its
+    ``n_experts/size`` experts on tokens collected from every rank.
+    Returns ``(y, aux)``: ``y[t]`` is the gated expert output (zeros for
+    capacity-dropped tokens — add the residual outside), ``aux`` the
+    load-balancing loss."""
+    from .tp import shard_axis
+
+    size = comm.size
+    T, d = x.shape
+    E = params["gate"].shape[1]
+    if E % size != 0:
+        raise ValueError(
+            f"n_experts ({E}) not divisible by world size ({size})")
+    epr = E // size
+    C = capacity
+
+    dispatch, combine, aux = top1_route(x @ params["gate"], C)
+
+    # (T, d) x (T, E, C) -> per-expert slot buffers, experts rank-major.
+    send = jnp.einsum("td,tec->ecd", x, dispatch)
+    send = send.reshape(size, epr * C, d)
+
+    if size > 1:
+        # Rank s keeps row s of the source-concatenated axis 1: its experts'
+        # slot buffers from every source rank.
+        recv = comm.Alltoall(send, gatheraxis=1, scatteraxis=0, numelem=1)
+        recv = recv.reshape(size, epr, C, d).transpose(1, 0, 2, 3)
+    else:
+        recv = send.reshape(1, epr, C, d).transpose(1, 0, 2, 3)
+    xin = recv.reshape(epr, size * C, d)
+
+    w1 = shard_axis(comm, params["w1"], 0)
+    b1 = shard_axis(comm, params["b1"], 0)
+    w2 = shard_axis(comm, params["w2"], 0)
+    b2 = shard_axis(comm, params["b2"], 0)
+    h = activation(jnp.einsum("esd,edf->esf", xin, w1) + b1[:, None, :])
+    yout = jnp.einsum("esf,efd->esd", h, w2) + b2[:, None, :]
+
+    # Inverse exchange: the same Alltoall pattern returns each token's
+    # expert output to its owner (the exchange is its own inverse layout).
+    back = yout.reshape(epr, size, C, d).transpose(1, 0, 2, 3)
+    back = back.reshape(size, epr * C, d)
+    if size > 1:
+        mine = comm.Alltoall(back, gatheraxis=1, scatteraxis=0, numelem=1)
+        mine = mine.reshape(E, C, d)
+    else:
+        mine = back.reshape(E, C, d)
+
+    # Bias must only reach tokens that actually occupied a slot: empty slots
+    # carry b2 after the expert FFN, and combine's zero rows remove them.
+    y = jnp.einsum("ecd,tec->td", mine, combine)
+    return y, aux
+
+
+def moe_ffn_dense(x, params: Dict[str, Any], capacity: int,
+                  activation=jax.nn.gelu):
+    """Single-device oracle: identical routing/capacity semantics, all
+    experts local.  Distributed and dense paths must agree token-for-token
+    (the EP correctness contract the tests pin down)."""
+    dispatch, combine, aux = top1_route(x @ params["gate"], capacity)
+    buf = jnp.einsum("td,tec->ecd", x, dispatch)
+    h = activation(jnp.einsum("ecd,edf->ecf", buf, params["w1"])
+                   + params["b1"][:, None, :])
+    yout = jnp.einsum("ecf,efd->ecd", h, params["w2"]) + params["b2"][:, None, :]
+    y = jnp.einsum("ecd,tec->td", yout, combine)
+    return y, aux

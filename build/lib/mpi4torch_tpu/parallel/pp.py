@@ -1,0 +1,160 @@
+"""Pipeline parallelism: microbatch transport over Isend/Irecv/Wait.
+
+The reference ships PP as "primitives only": the differentiable nonblocking
+trio plus ``JoinDummies`` ordering is exactly the stage-to-stage microbatch
+transport, and the backward pass auto-generates the reverse-direction sends
+(SURVEY.md §2.5 PP row; reference: csrc/extension.cpp:1048-1265,
+doc/basic_usage.rst:194-457).  This module packages the discipline:
+
+* :func:`send_activation` / :func:`recv_activation` — one hop of the
+  pipeline with the full token discipline applied, returning the
+  dependency token (send) or the received tensor (recv);
+* :func:`pipeline_step` — a GPipe-style fill-drain schedule: stage ``r`` =
+  rank ``r``, microbatches streamed through with per-microbatch tags, last
+  stage computes the loss.  Each rank's *surrogate output* joins its send
+  tokens, so backward on every rank triggers the mirror-image reverse
+  pipeline: cotangents physically travel rank ``r+1 -> r`` on ``tag+10``
+  (the reference's reverse-flow discipline, csrc/extension.cpp:1159-1218)
+  and stage parameters receive their exact gradients.
+
+The schedule runs on the eager thread-SPMD backend (per-rank programs —
+pipeline stages are inherently MIMD; the reference's PP story is likewise
+per-rank user programs).  On a TPU mesh the same model can instead be
+pipelined with stacked stage weights + ``ppermute`` under ``shard_map``;
+see doc/parallelism.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..comm import JoinDummies
+
+
+def send_activation(comm, x, dest: int, tag: int):
+    """Ship activation ``x`` to the next stage; returns the dependency
+    token that MUST be joined onto the rank's differentiated output (via
+    ``JoinDummies``) — that keeps the transfer on the backward path, where
+    its adjoint *receives* the downstream cotangent over the network."""
+    handle = comm.Isend(x, dest, tag)
+    return comm.Wait(handle)
+
+
+def recv_activation(comm, like, source: int, tag: int, deps: Sequence = ()):
+    """Receive an activation shaped/typed like ``like`` from the previous
+    stage.  ``deps`` are dependency values joined onto the receive buffer;
+    they MUST include something that depends on the parameters being
+    differentiated — otherwise the receive is invisible to the
+    linearization, its adjoint (which sends this activation's cotangent
+    back to ``source``) never runs, and the peer's backward deadlocks.
+    This is the reference's recv-buffer JoinDummies discipline (reference:
+    doc/basic_usage.rst:400-421, tests/test_nonblocking.py:10-16 — the
+    buffer is joined with the rank's own grad-requiring send)."""
+    buf = JoinDummies(jnp.zeros_like(like), list(deps)) if deps \
+        else jnp.zeros_like(like)
+    return comm.Recv(buf, source, tag)
+
+
+def pipeline_step(comm, apply_stage: Callable[[Any, Any], Any], params,
+                  microbatches: List, loss_fn: Callable[[Any, int], Any],
+                  recv_like=None, tag: int = 0):
+    """One training step of a GPipe fill-drain pipeline; returns
+    ``(loss, grads)`` on every rank.
+
+    Stage ``r`` = rank ``r``.  ``apply_stage(params, x) -> y`` is this
+    rank's stage function with this rank's ``params``; ``microbatches``
+    feed rank 0 (other ranks may pass the same list — only its length is
+    used); ``loss_fn(y, i)`` reduces the last stage's output for microbatch
+    ``i`` to a scalar; ``recv_like`` is an array shaped like this rank's
+    incoming activation (required on ranks > 0 — static shapes are the
+    XLA-native analogue of the reference's shape broadcast,
+    csrc/extension.cpp:788-796).
+
+    The returned ``loss`` is the total over microbatches, broadcast to all
+    ranks; ``grads`` is the gradient of that total w.r.t. this rank's stage
+    params — produced by the reverse pipeline, not by any parameter
+    exchange."""
+    rank, size = int(comm.rank), comm.size
+    n_mb = len(microbatches)
+    if size == 1:
+        def solo(p):
+            return sum(loss_fn(apply_stage(p, mb), i)
+                       for i, mb in enumerate(microbatches))
+        return jax.value_and_grad(solo)(params)
+    if rank > 0 and recv_like is None:
+        raise ValueError("ranks > 0 need recv_like (incoming activation "
+                         "shape/dtype)")
+
+    def surrogate(p):
+        tokens = []
+        total = jnp.zeros(())
+        # Ties every receive to the differentiated parameters so the
+        # reverse-pipeline sends appear in this rank's backward (see
+        # recv_activation's docstring).
+        p_dep = jax.tree.leaves(p)[0]
+        for i in range(n_mb):
+            t = tag + i
+            if rank == 0:
+                x = microbatches[i]
+            else:
+                x = recv_activation(comm, recv_like, rank - 1, t,
+                                    deps=[p_dep] + tokens[-1:])
+            y = apply_stage(p, x)
+            if rank < size - 1:
+                tokens.append(send_activation(comm, y, rank + 1, t))
+            else:
+                total = total + loss_fn(y, i)
+        # Joining the send tokens keeps every transfer on the DAG path from
+        # params to output — the docs' cardinal rule (all communication must
+        # lie on an input->output path or backward deadlocks, reference
+        # doc/basic_usage.rst:459-464).
+        return JoinDummies(total, tokens) if tokens else total
+
+    loss, grads = jax.value_and_grad(surrogate)(params)
+    # Only the last stage holds the real loss; replicate it (in-place Bcast
+    # keeps reference semantics: non-root inputs are overwritten).
+    loss = comm.Bcast_(loss, size - 1)
+    return loss, grads
+
+
+def pipeline_spmd(comm, apply_stage: Callable[[Any, Any], Any],
+                  stage_params, microbatches: List,
+                  loss_fn: Callable[[Any, int], Any]):
+    """Single-trace GPipe for the SPMD mesh backend: returns the total
+    pipeline loss, identical on every rank.
+
+    The MIMD fill-drain schedule of :func:`pipeline_step` re-expressed as
+    one uniform program (SURVEY.md §7 hard part 4 — rank-dependent behavior
+    becomes array masking): every rank holds its stage's params
+    (``stage_params``, already sliced — e.g. ``shard_axis`` of a stacked
+    ``(size, ...)`` tree), activations advance one hop per step over the
+    differentiable ring (``ppermute`` on ICI), rank 0 injects microbatches,
+    and the last rank's masked contributions accumulate into the loss.
+    ``n_mb + size - 1`` steps total; each step's compute is live on the
+    ranks inside the fill-drain window and masked elsewhere.  Gradients
+    need no token plumbing: the ring transport's adjoint is the reverse
+    ring, generated by ``jax.grad`` of the returned loss."""
+    from .ring import ring_shift
+    from ..constants import MPI_SUM
+
+    size = comm.size
+    n_mb = len(microbatches)
+    rank = jnp.asarray(comm.rank)
+    x = jnp.zeros_like(microbatches[0])
+    total = jnp.zeros(())
+    for step in range(n_mb + size - 1):
+        if step < n_mb:
+            x = jnp.where(rank == 0, microbatches[step], x)
+        y = apply_stage(stage_params, x)
+        mb_idx = step - (size - 1)
+        if 0 <= mb_idx < n_mb:
+            total = total + jnp.where(rank == size - 1,
+                                      loss_fn(y, mb_idx), 0.0)
+        if step + 1 < n_mb + size - 1:
+            x = ring_shift(comm, y, 1, tag=step)
+    if size > 1:
+        total = comm.Allreduce(total, MPI_SUM)
+    return total

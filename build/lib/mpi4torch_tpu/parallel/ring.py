@@ -1,0 +1,70 @@
+"""Differentiable ring transport: the CP/pipeline building block.
+
+The reference's nonblocking trio composed into the ring pattern of its own
+example (reference: examples/isend-recv-wait.py:8-13, tests/
+test_nonblocking.py:10-16), with the full JoinDummies/WaitHandle token
+discipline (SURVEY.md §3.4) applied internally so users get a one-call,
+AD-transparent ring shift.  Backward is the mirror-image ring in the
+opposite direction — gradients physically travel the reverse ring
+(reference: csrc/extension.cpp:1159-1218).
+
+Under the SPMD mesh backend each matched Isend/Irecv pair lowers to ONE
+``collective_permute`` riding the ICI torus — the optimal topology mapping
+for a ring on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..comm import JoinDummies, JoinDummiesHandle
+
+
+def ring_shift(comm, x, shift: int = 1, tag: int = 0):
+    """Send ``x`` to rank ``(rank + shift) % size``; return the tensor
+    received from ``(rank - shift) % size``.
+
+    Differentiable: the adjoint is a ring shift by ``-shift`` of the
+    cotangent (the reverse-direction gradient ring).  ``shift`` must be a
+    Python int (a static ring displacement)."""
+    size = comm.size
+    if size == 1 or shift % size == 0:
+        return x
+    dest = (comm.rank + shift) % size
+    source = (comm.rank - shift) % size
+    handle = comm.Isend(x, dest, tag)
+    buf = JoinDummies(jnp.zeros_like(x), [handle.dummy])
+    received = comm.Recv(buf, source, tag)
+    ret = comm.Wait(JoinDummiesHandle(handle, [received]))
+    return JoinDummies(received, [ret])
+
+
+def halo_exchange(comm, x, halo: int, axis: int = 0, tag: int = 0):
+    """Periodic halo exchange along ``axis``: returns ``x`` padded with its
+    neighbors' boundary slices, shape grown by ``2 * halo`` on ``axis``.
+
+    The distributed-stencil primitive (BASELINE.md parity config #5): rank
+    r's result is ``[right edge of rank r-1 | x | left edge of rank r+1]``.
+    Fully differentiable — boundary gradients flow back to the neighbor
+    that owns them over the reverse ring."""
+    if halo <= 0:
+        raise ValueError(f"halo must be positive, got {halo}")
+    n = x.shape[axis]
+    if halo > n:
+        raise ValueError(
+            f"halo {halo} exceeds local axis length {n} (axis {axis})")
+
+    def take(start, count):
+        idx = [slice(None)] * x.ndim
+        idx[axis] = slice(start, start + count)
+        return x[tuple(idx)]
+
+    if comm.size == 1:
+        left = take(n - halo, halo)
+        right = take(0, halo)
+    else:
+        # My left neighbor's rightmost slice reaches me via a +1 ring shift;
+        # my right neighbor's leftmost slice via a -1 shift.
+        left = ring_shift(comm, take(n - halo, halo), 1, tag)
+        right = ring_shift(comm, take(0, halo), -1, tag + 1)
+    return jnp.concatenate([left, x, right], axis=axis)

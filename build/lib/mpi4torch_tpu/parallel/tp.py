@@ -1,0 +1,134 @@
+"""Tensor parallelism: column/row-parallel layers from the op table.
+
+The reference ships TP as "primitives only" — its axis-aware
+``Gather``/``Allgather``/``Scatter`` with per-rank shard sizes are exactly
+the column/row-parallel glue (SURVEY.md §2.5; reference:
+csrc/extension.cpp:497-884).  This module packages the two canonical
+Megatron-style sharded layers and their composition on top of the
+AD-transparent communicator ops, so forward AND backward communication is
+generated automatically by the ops' adjoints:
+
+* column-parallel linear (weight sharded on the OUTPUT feature axis) —
+  optional ``Allgather`` of the outputs, whose adjoint is the matching
+  reduce-scatter-shaped sum-of-Scatters;
+* row-parallel linear (weight sharded on the INPUT feature axis) —
+  partial products combined with ``Allreduce(SUM)``, whose adjoint
+  broadcasts the cotangent to every rank;
+* the column→act→row MLP pairing, which needs exactly ONE collective per
+  direction (the TP pattern that keeps matmuls MXU-sized while halving
+  nothing but the weight memory).
+
+Everything here runs on either backend; under ``run_spmd``/``comm_from_mesh``
+the collectives lower to XLA ``all_gather``/``psum`` over an ICI mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import MPI_SUM
+
+
+def shard_axis(comm, x, axis: int):
+    """This rank's equal shard of ``x`` along ``axis`` (rank-major order).
+
+    Trace-safe: uses ``dynamic_slice`` so ``comm.rank`` may be a traced
+    ``lax.axis_index`` under the SPMD backend.  ``x`` must be replicated
+    (every rank passes the same full tensor), the local analogue of the
+    reference's root-broadcast ``Scatter`` semantics."""
+    size = comm.size
+    n = x.shape[axis]
+    if n % size != 0:
+        raise ValueError(
+            f"axis {axis} length {n} not divisible by world size {size}")
+    local = n // size
+    start = jnp.asarray(comm.rank) * local
+    return jax.lax.dynamic_slice_in_dim(x, start, local, axis)
+
+
+def column_parallel_linear(comm, x, w_shard, b_shard=None,
+                           gather_output: bool = True):
+    """``y = x @ W + b`` with ``W`` sharded column-wise (output features).
+
+    Each rank computes its slice of the output features; with
+    ``gather_output`` the feature axis is reassembled with ``Allgather``
+    (adjoint: each rank receives the gradient slice it owns).  With
+    ``gather_output=False`` the output stays feature-sharded — feed it to
+    :func:`row_parallel_linear` to defer communication to one Allreduce."""
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    if gather_output:
+        y = comm.Allgather(y, gatheraxis=y.ndim - 1)
+    return y
+
+
+def row_parallel_linear(comm, x_shard, w_shard, b=None,
+                        reduce_output: bool = True):
+    """``y = x @ W + b`` with ``W`` sharded row-wise (input features).
+
+    ``x_shard`` is the matching feature shard of the input (e.g. the
+    ungathered output of a column-parallel layer).  Partial products are
+    summed across ranks with ``Allreduce(SUM)`` — the single collective of
+    the column→row pairing; its adjoint re-broadcasts the output cotangent
+    so every rank's weight shard receives its exact gradient.  The bias is
+    replicated and added AFTER the reduction (adding it to each partial sum
+    would count it ``size`` times)."""
+    y = x_shard @ w_shard
+    if reduce_output:
+        y = comm.Allreduce(y, MPI_SUM)
+    elif b is not None:
+        raise ValueError(
+            "row_parallel_linear(reduce_output=False) cannot add a "
+            "replicated bias to per-rank partial sums — a later "
+            "Allreduce would count it size times; add b after reducing")
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(comm, x, w1_shard, b1_shard, w2_shard, b2,
+           activation=jax.nn.gelu):
+    """Megatron-style tensor-parallel MLP: column(w1) → act → row(w2).
+
+    One ``Allreduce`` forward, one (its adjoint) backward — the minimal
+    communication schedule for a 2-layer MLP.  ``w1`` is sharded on its
+    output axis, ``w2`` on its input axis, with matching shards
+    (``w1_shard: (d, f/size)``, ``w2_shard: (f/size, d)``)."""
+    h = column_parallel_linear(comm, x, w1_shard, b1_shard,
+                               gather_output=False)
+    return row_parallel_linear(comm, activation(h), w2_shard, b2)
+
+
+def tp_attention(comm, q_proj, k_proj, v_proj, o_proj, x, n_heads: int,
+                 attention_fn=None, causal: bool = True):
+    """Head-sharded (tensor-parallel) self-attention.
+
+    QKV projections are column-parallel (each rank owns ``n_heads/size``
+    heads end-to-end), the output projection is row-parallel; like
+    :func:`tp_mlp` this costs exactly one ``Allreduce`` per direction.
+    ``x`` is ``(batch, seq, d_model)`` replicated across the TP group;
+    ``q/k/v_proj`` are ``(d_model, d_model/size)`` shards, ``o_proj`` the
+    matching ``(d_model/size, d_model)`` row shard."""
+    from .attention import dense_attention
+
+    size = comm.size
+    if n_heads % size != 0:
+        raise ValueError(
+            f"n_heads ({n_heads}) not divisible by world size ({size})")
+    h_local = n_heads // size
+    b, s, _ = x.shape
+    if attention_fn is None:
+        attention_fn = dense_attention
+
+    def heads(t):
+        return t.reshape(b, s, h_local, t.shape[-1] // h_local)
+
+    q = heads(column_parallel_linear(comm, x, q_proj, gather_output=False))
+    k = heads(column_parallel_linear(comm, x, k_proj, gather_output=False))
+    v = heads(column_parallel_linear(comm, x, v_proj, gather_output=False))
+    o = attention_fn(q, k, v, causal=causal)
+    return row_parallel_linear(comm, o.reshape(b, s, -1), o_proj)

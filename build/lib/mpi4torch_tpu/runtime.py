@@ -1,0 +1,384 @@
+"""Thread-SPMD eager runtime ("Mode B") — the `mpirun -np N` analogue.
+
+The reference library is executed as N OS processes under ``mpirun``, each
+running the whole user script with a concrete ``rank`` (SURVEY.md §4: CI runs
+``mpirun -np {2,5,7} nose2`` with oversubscription).  This module provides the
+TPU-framework analogue for a single host: N Python *threads*, each running the
+per-rank function with a concrete Python-int rank, where every communication
+op is a rendezvous across the threads.  This is the harness that lets the
+reference's tests and examples — per-rank-varying shapes, ``if comm.rank == 0``
+branches, eager ``jax.grad`` — run essentially verbatim.  The SPMD-traced
+path over a real device mesh ("Mode A", mpi4torch_tpu/ops/spmd.py) is the
+performance path; this executor is the semantics/parity path, exactly like
+CI-oversubscribed MPI processes are for the reference.
+
+Replaces (TPU-natively) these reference components:
+  * MPI init-on-import + finalizer        (csrc/extension.cpp:1313-1394)
+  * communicator wrapper / rank / size    (csrc/extension.cpp:140-187)
+  * request-handle management             (csrc/extension.cpp:1089-1107,1220-1249)
+  * error checking -> exceptions          (csrc/extension.cpp:131-138)
+
+It is deliberately *stricter* than MPI: mismatched collectives raise a
+``CollectiveMismatchError`` instead of deadlocking or corrupting data, stalls
+raise ``DeadlockError`` after a timeout, and misuse of wait handles raises
+immediately (the reference's guards: csrc/extension.cpp:395-403, 1196-1202,
+1231-1237).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class CommError(RuntimeError):
+    """Base class for communication-runtime errors (analogue of the
+    reference's ``check_mpi_return_value`` -> std::runtime_error,
+    csrc/extension.cpp:131-138)."""
+
+
+class CollectiveMismatchError(CommError):
+    """Raised when ranks disagree on which collective (or which parameters)
+    they are executing.  MPI would deadlock or corrupt buffers; we detect."""
+
+
+class DeadlockError(CommError):
+    """Raised when a rendezvous times out — the analogue of an MPI hang."""
+
+
+class InPlaceReuseError(CommError):
+    """Raised when a tensor consumed by an in-place collective is passed to a
+    later communication op (reference: 'Reuse of variables passed to in-place
+    MPI kernels not supported', csrc/extension.cpp:395-403, 451-462)."""
+
+
+class BifurcationError(CommError):
+    """Raised when a wait handle is reused/spliced/waited twice (reference:
+    'Detected bifurcation in MPIWait handle usage',
+    csrc/extension.cpp:1196-1202, 1231-1237)."""
+
+
+# Request descriptor op codes (descriptor layout mirrors the 7-element
+# descriptor of csrc/extension.cpp:1094-1102).
+REQ_ISEND = 1
+REQ_IRECV = 2
+
+
+@dataclass
+class _PendingRequest:
+    req_id: int
+    kind: int                 # REQ_ISEND / REQ_IRECV
+    rank: int                 # owning rank
+    peer: int                 # dest (isend) or source (irecv)
+    tag: int
+    shape: Tuple[int, ...]
+    dtype: Any
+    fingerprint: int
+
+
+def _fnv1a(parts) -> int:
+    """FNV-1a hash over a string description — the analogue of the 32-bit
+    data-pointer hash the reference smuggles into the request descriptor
+    (csrc/extension.cpp:1100, re-checked at 1231-1237).  Kept pure-Python:
+    the inputs are tiny and this sits on the request-creation hot path, so
+    it must never wait on the native library's first build (the identical
+    native fnv1a32 exists for bulk hashing and is tested bit-equal)."""
+    h = 0x811C9DC5
+    for ch in "|".join(str(p) for p in parts).encode():
+        h ^= ch
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+class World:
+    """A set of ``size`` rank-threads with rendezvous-based communication.
+
+    One ``World`` is the analogue of an ``MPI_COMM_WORLD`` instance spanning N
+    processes (csrc/extension.cpp:140-187).  All collective ops funnel through
+    :meth:`exchange`, which is a barrier + all-to-all of per-rank payloads plus
+    a signature consistency check.
+    """
+
+    def __init__(self, size: int, timeout: float = 60.0):
+        if size < 1:
+            raise ValueError("World size must be >= 1")
+        self.size = size
+        self.timeout = timeout
+        self._barrier = threading.Barrier(size)
+        self._slots: List[Any] = [None] * size
+        self._sigs: List[Any] = [None] * size
+        self._mailboxes: Dict[Tuple[int, int, int], "queue.Queue"] = {}
+        self._mb_lock = threading.Lock()
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending: Dict[int, _PendingRequest] = {}
+        self._consumed: Dict[int, Any] = {}   # id(x) -> strong ref (in-place guard)
+        self._failed = threading.Event()
+        self._first_error: Optional[BaseException] = None
+        self._err_lock = threading.Lock()
+
+    # ---------------------------------------------------------------- errors
+
+    def fail(self, exc: BaseException) -> None:
+        """Mark the world failed and wake everyone blocked on the barrier."""
+        with self._err_lock:
+            if self._first_error is None:
+                self._first_error = exc
+        self._failed.set()
+        self._barrier.abort()
+
+    def _check_failed(self):
+        if self._failed.is_set():
+            raise CommError(
+                "communication world already failed on another rank"
+            ) from self._first_error
+
+    # ----------------------------------------------------------- collectives
+
+    def exchange(self, rank: int, signature: Tuple, payload: Any) -> List[Any]:
+        """All ranks deposit (signature, payload); returns the list of all
+        payloads in rank order.  Signature mismatch across ranks raises on
+        every rank (MPI would deadlock/corrupt; see class docstring).
+        """
+        self._check_failed()
+        self._sigs[rank] = signature
+        self._slots[rank] = payload
+        self._wait_barrier()
+        sig0 = self._sigs[0]
+        if any(s != sig0 for s in self._sigs):
+            err = CollectiveMismatchError(
+                "ranks disagree on the collective being executed: "
+                + "; ".join(f"rank {i}: {s}" for i, s in enumerate(self._sigs))
+            )
+            # Everyone observes the same mismatch => everyone raises; no need
+            # to abort the barrier.
+            raise err
+        out = list(self._slots)
+        self._wait_barrier()  # all readers done before slots are reused
+        return out
+
+    def barrier(self, rank: int) -> None:
+        self.exchange(rank, ("Barrier",), None)
+
+    def _wait_barrier(self):
+        try:
+            self._barrier.wait(timeout=self.timeout)
+        except threading.BrokenBarrierError:
+            if self._first_error is not None:
+                raise CommError(
+                    "collective aborted because another rank failed"
+                ) from self._first_error
+            raise DeadlockError(
+                f"collective rendezvous timed out after {self.timeout}s — a "
+                "rank did not reach the matching collective (the analogue of "
+                "an MPI deadlock; every rank must execute the same "
+                "communication sequence, see SURVEY.md §3.3)"
+            ) from None
+
+    # ------------------------------------------------------------------ p2p
+
+    def _mailbox(self, src: int, dst: int, tag: int) -> "queue.Queue":
+        key = (src, dst, tag)
+        with self._mb_lock:
+            q = self._mailboxes.get(key)
+            if q is None:
+                q = queue.Queue()
+                self._mailboxes[key] = q
+            return q
+
+    def p2p_send(self, src: int, dst: int, tag: int, payload: Any) -> None:
+        """Buffered-mode send: never blocks (the eager analogue of MPI_Isend,
+        csrc/extension.cpp:1071-1113)."""
+        self._check_failed()
+        if not (0 <= dst < self.size):
+            raise CommError(f"invalid destination rank {dst} (size {self.size})")
+        self._mailbox(src, dst, tag).put(payload)
+
+    def p2p_recv(self, src: int, dst: int, tag: int) -> Any:
+        """Blocking receive with deadlock timeout (analogue of MPI_Irecv+Wait,
+        csrc/extension.cpp:1115-1157, 1245-1249)."""
+        if not (0 <= src < self.size):
+            raise CommError(f"invalid source rank {src} (size {self.size})")
+        q = self._mailbox(src, dst, tag)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            self._check_failed()
+            try:
+                return q.get(timeout=0.05)
+            except queue.Empty:
+                if time.monotonic() > deadline:
+                    raise DeadlockError(
+                        f"receive (src={src}, dst={dst}, tag={tag}) timed out "
+                        f"after {self.timeout}s — matching send never posted"
+                    ) from None
+
+    # ------------------------------------------------------------- requests
+
+    def new_request(self, kind: int, rank: int, peer: int, tag: int,
+                    shape: Tuple[int, ...], dtype: Any) -> _PendingRequest:
+        with self._req_lock:
+            self._req_counter += 1
+            rid = self._req_counter
+        fp = _fnv1a((rid, kind, peer, tag, shape, str(dtype)))
+        req = _PendingRequest(rid, kind, rank, peer, tag, tuple(shape), dtype, fp)
+        with self._req_lock:
+            self._pending[rid] = req
+        return req
+
+    def complete_request(self, req_id: int, shape: Tuple[int, ...],
+                         dtype: Any) -> _PendingRequest:
+        """Pop a pending request, enforcing the reference's wait-handle
+        guards (csrc/extension.cpp:1231-1237: descriptor hash re-check;
+        1196-1202: backward-graph shape check)."""
+        with self._req_lock:
+            req = self._pending.pop(req_id, None)
+        if req is None:
+            raise BifurcationError(
+                f"Detected bifurcation in Wait handle usage: request {req_id} "
+                "is unknown or was already waited on (a WaitHandle must be "
+                "waited on exactly once, and its parts must not be swapped "
+                "between handles; reference guard csrc/extension.cpp:1231-1237)"
+            )
+        if tuple(shape) != req.shape or dtype != req.dtype:
+            with self._req_lock:
+                self._pending[req_id] = req  # restore for diagnostics
+            raise BifurcationError(
+                "Detected bifurcation in Wait handle usage: the buffer in the "
+                f"handle (shape {tuple(shape)}, dtype {dtype}) does not match "
+                f"the posted request (shape {req.shape}, dtype {req.dtype})"
+            )
+        return req
+
+    # -------------------------------------------------- in-place reuse guard
+
+    # Bound on the consumed-input guard table: entries beyond this are
+    # evicted FIFO (dropping an entry only weakens detection for that old
+    # tensor; it can never cause a false positive, because evicting also
+    # drops the strong ref that pinned the id).
+    _CONSUMED_CAP = 4096
+
+    def mark_consumed(self, x: Any) -> None:
+        """Record ``x`` as consumed by an in-place collective.  The reference
+        splices an ``MPINoInplaceBackward`` node onto the *input* of Reduce_
+        so any later use raises at backward time (csrc/extension.cpp:395-403,
+        451-462).  Functionally-pure JAX has no aliasing hazard, so this is a
+        parity/discipline guard: later *communication* ops reject the value.
+        """
+        self._consumed[id(x)] = x  # strong ref pins id while tracked
+        while len(self._consumed) > self._CONSUMED_CAP:
+            self._consumed.pop(next(iter(self._consumed)))
+
+    def check_not_consumed(self, *arrays: Any) -> None:
+        for a in arrays:
+            if id(a) in self._consumed:
+                raise InPlaceReuseError(
+                    "Reuse of variables passed to in-place MPI kernels is not "
+                    "supported (reference guard csrc/extension.cpp:451-462): "
+                    "this tensor was consumed by Reduce_ — use its return "
+                    "value instead"
+                )
+
+
+@dataclass
+class RankContext:
+    """Binds the current thread to (world, rank) — the eager analogue of the
+    per-process MPI rank identity."""
+    world: World
+    rank: int
+
+
+_tls = threading.local()
+
+
+def current_rank_context() -> Optional[RankContext]:
+    return getattr(_tls, "ctx", None)
+
+
+class _bind_rank:
+    def __init__(self, ctx: RankContext):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self.prev
+        return False
+
+
+# A default single-rank world so that user scripts work without any launcher,
+# exactly like running an MPI program without mpirun (world size 1).
+_default_world = World(1)
+_default_ctx = RankContext(_default_world, 0)
+
+
+def effective_rank_context() -> RankContext:
+    ctx = current_rank_context()
+    return ctx if ctx is not None else _default_ctx
+
+
+def run_ranks(fn: Callable, nranks: int, timeout: float = 60.0,
+              return_results: bool = True) -> List[Any]:
+    """Run ``fn`` on ``nranks`` rank-threads — the `mpirun -np N` analogue.
+
+    ``fn`` is called either as ``fn()`` or ``fn(rank)`` (if it accepts one
+    positional argument).  Inside, ``mpi4torch_tpu.COMM_WORLD`` resolves to
+    this world with a concrete Python-int rank, so reference-style per-rank
+    scripts (rank-conditional shapes and asserts) run unmodified in spirit
+    (SURVEY.md §4 'What the rebuild needs').
+
+    Exceptions: the first per-rank exception is re-raised on the caller
+    after all threads have been reaped; other ranks' failures are attached
+    as context.
+    """
+    import inspect
+
+    world = World(nranks, timeout=timeout)
+    results: List[Any] = [None] * nranks
+    errors: List[Optional[BaseException]] = [None] * nranks
+
+    try:
+        nparams = len([
+            p for p in inspect.signature(fn).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.default is p.empty
+        ])
+    except (TypeError, ValueError):
+        nparams = 0
+
+    def worker(rank: int):
+        with _bind_rank(RankContext(world, rank)):
+            try:
+                results[rank] = fn(rank) if nparams >= 1 else fn()
+            except BaseException as e:  # noqa: BLE001 — reaped below
+                errors[rank] = e
+                world.fail(e)
+
+    threads = [threading.Thread(target=worker, args=(r,), name=f"rank{r}")
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    failed = [(r, e) for r, e in enumerate(errors) if e is not None]
+    if failed:
+        # Prefer the root-cause error over secondary abort noise, and attach
+        # the other ranks' failures as context.
+        primary = world._first_error
+        if primary is None or primary not in errors:
+            primary = failed[0][1]
+        secondary = [(r, e) for r, e in failed if e is not primary]
+        if secondary:
+            primary.add_note(
+                "other rank failures: "
+                + "; ".join(f"rank {r}: {type(e).__name__}: {e}"
+                            for r, e in secondary)
+            )
+        raise primary
+    return results if return_results else []

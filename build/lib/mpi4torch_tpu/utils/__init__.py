@@ -1,0 +1,5 @@
+"""Utilities: eager optimizers and test helpers."""
+
+from .lbfgs import LBFGS, minimize_lbfgs
+
+__all__ = ["LBFGS", "minimize_lbfgs"]

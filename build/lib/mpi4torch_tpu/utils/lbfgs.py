@@ -1,0 +1,225 @@
+"""Eager L-BFGS with strong-Wolfe line search.
+
+The reference's canonical data-parallel example drives ``torch.optim.LBFGS``
+with a closure (reference: examples/simple_linear_regression.py:40-53) —
+an *eager* optimizer whose line-search control flow runs in Python.  That
+matters for AD-transparent communication: every loss evaluation executes
+collectives on every rank, and because the Allreduce'd loss and gradients
+are replicated, all ranks take identical line-search branches and stay in
+lock-step (the property documented at reference doc/examples.rst:46-65).
+
+``optax.lbfgs`` evaluates the loss inside ``lax.while_loop`` — traced — so
+it cannot drive the eager thread-SPMD runtime.  This module provides the
+eager equivalent: plain-Python control flow over jnp scalars, pytree
+parameters via ``ravel_pytree``.  It also runs fine single-process and
+under the SPMD backend's ``jit=False`` mode.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def _local_dot(a, b):
+    return float(jnp.vdot(a, b))
+
+
+def _make_reducers(comm):
+    """(dot, max_abs, sum_abs) over the optimization variable.
+
+    With a communicator the variable is *domain-decomposed* (each rank owns
+    a disjoint slice, e.g. the stencil example's row block) and every
+    scalar the algorithm branches on must be the GLOBAL reduction —
+    otherwise ranks take different line-search branches and the collectives
+    inside ``loss_fn`` deadlock (SURVEY.md §3.3: every rank must execute
+    the same communication sequence).  Without one, the variable is
+    replicated and local reductions are already rank-identical."""
+    if comm is None or comm.size == 1:
+        return (_local_dot,
+                lambda a: float(jnp.max(jnp.abs(a))),
+                lambda a: float(jnp.sum(jnp.abs(a))))
+    from ..constants import MPI_MAX, MPI_SUM
+
+    def dot(a, b):
+        return float(comm.Allreduce(jnp.vdot(a, b), MPI_SUM))
+
+    def max_abs(a):
+        return float(comm.Allreduce(jnp.max(jnp.abs(a)), MPI_MAX))
+
+    def sum_abs(a):
+        return float(comm.Allreduce(jnp.sum(jnp.abs(a)), MPI_SUM))
+
+    return dot, max_abs, sum_abs
+
+
+def _strong_wolfe(fg, x, d, f0, g0, *, c1=1e-4, c2=0.9, max_evals=25,
+                  t0=1.0, _dot=_local_dot):
+    """Standard bracket+zoom strong-Wolfe line search on phi(t) = f(x+t*d).
+
+    Returns (t, f_t, g_t, n_evals).  Falls back to the best point seen if
+    the conditions cannot be satisfied within the evaluation budget.
+    """
+    dphi0 = _dot(g0, d)
+    if dphi0 >= 0:
+        # Not a descent direction (numerical breakdown) — signal caller.
+        return 0.0, f0, g0, 0
+
+    def phi(t):
+        f, g = fg(x + t * d)
+        return float(f), g
+
+    evals = 0
+    t_prev, f_prev, g_prev = 0.0, float(f0), g0
+    t = t0
+    best = (0.0, float(f0), g0)
+
+    bracket = None
+    for _ in range(max_evals):
+        f_t, g_t = phi(t)
+        evals += 1
+        if f_t < best[1]:
+            best = (t, f_t, g_t)
+        dphi_t = _dot(g_t, d)
+        if f_t > float(f0) + c1 * t * dphi0 or (evals > 1 and f_t >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, t, f_t, g_t)
+            break
+        if abs(dphi_t) <= -c2 * dphi0:
+            return t, f_t, g_t, evals
+        if dphi_t >= 0:
+            bracket = (t, f_t, g_t, t_prev, f_prev, g_prev)
+            break
+        t_prev, f_prev, g_prev = t, f_t, g_t
+        t = 2.0 * t
+    if bracket is None:
+        return best[0], best[1], best[2], evals
+
+    lo_t, lo_f, lo_g, hi_t, hi_f, hi_g = bracket
+    for _ in range(max_evals - evals):
+        t = 0.5 * (lo_t + hi_t)
+        f_t, g_t = phi(t)
+        evals += 1
+        if f_t < best[1]:
+            best = (t, f_t, g_t)
+        dphi_t = _dot(g_t, d)
+        if f_t > float(f0) + c1 * t * dphi0 or f_t >= lo_f:
+            hi_t, hi_f, hi_g = t, f_t, g_t
+        else:
+            if abs(dphi_t) <= -c2 * dphi0:
+                return t, f_t, g_t, evals
+            if dphi_t * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+            lo_t, lo_f, lo_g = t, f_t, g_t
+        if abs(hi_t - lo_t) < 1e-12:
+            break
+    return best[0], best[1], best[2], evals
+
+
+def minimize_lbfgs(loss_fn: Callable, params, *, max_iter: int = 20,
+                   history_size: int = 10, tolerance_grad: float = 1e-10,
+                   tolerance_change: float = 1e-12,
+                   value_and_grad: bool = False, comm=None):
+    """Minimize ``loss_fn(params)`` with L-BFGS (two-loop recursion, strong
+    Wolfe).  ``params`` may be any pytree.  Returns ``(params, final_loss)``.
+
+    Every loss/gradient evaluation happens eagerly, so communication ops
+    inside ``loss_fn`` run in rank lock-step — the eager analogue of
+    ``torch.optim.LBFGS`` driving the reference's distributed closure
+    (reference: examples/simple_linear_regression.py:40-53).
+
+    Pass ``comm`` when ``params`` is domain-decomposed across ranks (each
+    rank optimizes its own disjoint slice of one global variable, and
+    ``loss_fn`` returns the Allreduce'd global loss): all inner products
+    and norms the algorithm branches on are then globally reduced, keeping
+    ranks' control flow in lock-step.  Leave it ``None`` for replicated
+    parameters (the reference's DP recipe)."""
+    x0, unravel = ravel_pytree(params)
+    fg_tree = loss_fn if value_and_grad else jax.value_and_grad(loss_fn)
+    _dot, _max_abs, _sum_abs = _make_reducers(comm)
+
+    def fg(xflat):
+        f, g = fg_tree(unravel(xflat))
+        return f, ravel_pytree(g)[0]
+
+    x = x0
+    f, g = fg(x)
+    s_hist: List = []
+    y_hist: List = []
+    rho_hist: List = []
+
+    for _ in range(max_iter):
+        if _max_abs(g) <= tolerance_grad:
+            break
+        # Two-loop recursion
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                             reversed(rho_hist)):
+            a = rho * _dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if y_hist:
+            gamma = _dot(s_hist[-1], y_hist[-1]) / max(
+                _dot(y_hist[-1], y_hist[-1]), 1e-300)
+        else:
+            gamma = 1.0
+        r = gamma * q
+        for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                  reversed(alphas)):
+            b = rho * _dot(y, r)
+            r = r + s * (a - b)
+        d = -r
+
+        t0 = min(1.0, 1.0 / max(_sum_abs(g), 1e-300)) \
+            if not y_hist else 1.0
+        t, f_new, g_new, _ = _strong_wolfe(fg, x, d, f, g, t0=t0, _dot=_dot)
+        if t == 0.0:
+            break
+        x_new = x + t * d
+        s = x_new - x
+        y = g_new - g
+        sy = _dot(s, y)
+        if sy > 1e-10:
+            s_hist.append(s)
+            y_hist.append(y)
+            rho_hist.append(1.0 / sy)
+            if len(s_hist) > history_size:
+                s_hist.pop(0)
+                y_hist.pop(0)
+                rho_hist.pop(0)
+        if _max_abs(s) <= tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            break
+        x, f, g = x_new, f_new, g_new
+
+    return unravel(x), f
+
+
+class LBFGS:
+    """Closure-style wrapper matching the shape of the reference example's
+    optimizer loop (reference: examples/simple_linear_regression.py:42-53):
+
+        opt = LBFGS(max_iter=20)
+        params, loss = opt.step(lossfn, params)
+
+    ``comm`` enables the domain-decomposed mode (see
+    :func:`minimize_lbfgs`)."""
+
+    def __init__(self, max_iter: int = 20, history_size: int = 10,
+                 tolerance_grad: float = 1e-10,
+                 tolerance_change: float = 1e-12, comm=None):
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.comm = comm
+
+    def step(self, loss_fn: Callable, params) -> Tuple:
+        return minimize_lbfgs(
+            loss_fn, params, max_iter=self.max_iter,
+            history_size=self.history_size,
+            tolerance_grad=self.tolerance_grad,
+            tolerance_change=self.tolerance_change, comm=self.comm)

@@ -3,6 +3,8 @@ reproduce the single-process full-batch full-sequence run — loss AND updated
 parameters — for both sequence-parallel attention strategies, on the SPMD
 mesh (user-managed 2D shard_map) and the eager runtime."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +30,32 @@ def reference_step(params, tokens):
     return T.train_step(CFG, params, tokens)  # size-1 world, dense attn
 
 
+def make_mesh_step(cfg, dp, sp, attn, ep=1):
+    """jitted shard_map train step over a dp x sp (x ep) mesh — the one
+    place the dynamic-slice + shard_map boilerplate lives."""
+    shape = (dp, sp, ep) if ep > 1 else (dp, sp)
+    names = ("dp", "sp", "ep")[:len(shape)]
+    mesh = Mesh(np.asarray(jax.devices()[:dp * sp * ep]).reshape(shape),
+                names)
+    comm_dp = mpi.comm_from_mesh(mesh, "dp")
+    comm_sp = mpi.comm_from_mesh(mesh, "sp")
+    comm_ep = mpi.comm_from_mesh(mesh, "ep") if ep > 1 else None
+    bl, sl = B // (dp * ep), S // sp
+
+    def shard_step(params, tokens):
+        r_b = jnp.asarray(comm_dp.rank)
+        if comm_ep is not None:
+            r_b = r_b * ep + jnp.asarray(comm_ep.rank)
+        r_sp = jnp.asarray(comm_sp.rank)
+        local = jax.lax.dynamic_slice(tokens, (r_b * bl, r_sp * sl),
+                                      (bl, sl))
+        return T.train_step(cfg, params, local, comm_sp=comm_sp,
+                            comm_dp=comm_dp, comm_ep=comm_ep, attn=attn)
+
+    return jax.jit(shard_map(shard_step, mesh=mesh, in_specs=P(),
+                             out_specs=P(), check_vma=False))
+
+
 @pytest.mark.parametrize("attn", ["ring", "ulysses"])
 @pytest.mark.parametrize("dp,sp", [(2, 4), (4, 2), (1, 8), (8, 1)])
 def test_2d_mesh_matches_single_process(attn, dp, sp):
@@ -36,23 +64,7 @@ def test_2d_mesh_matches_single_process(attn, dp, sp):
     params, tokens = setup()
     ref_loss, ref_params = reference_step(params, tokens)
 
-    mesh = Mesh(np.asarray(jax.devices()[:dp * sp]).reshape(dp, sp),
-                ("dp", "sp"))
-    comm_dp = mpi.comm_from_mesh(mesh, "dp")
-    comm_sp = mpi.comm_from_mesh(mesh, "sp")
-    bl, sl = B // dp, S // sp
-
-    def shard_step(params, tokens):
-        r_dp = jnp.asarray(comm_dp.rank)
-        r_sp = jnp.asarray(comm_sp.rank)
-        local = jax.lax.dynamic_slice(tokens, (r_dp * bl, r_sp * sl),
-                                      (bl, sl))
-        return T.train_step(CFG, params, local, comm_sp=comm_sp,
-                            comm_dp=comm_dp, attn=attn)
-
-    step = jax.jit(shard_map(shard_step, mesh=mesh, in_specs=P(),
-                             out_specs=P(), check_vma=False))
-    loss, new_params = step(params, tokens)
+    loss, new_params = make_mesh_step(CFG, dp, sp, attn)(params, tokens)
 
     np.testing.assert_allclose(float(loss), float(ref_loss),
                                rtol=1e-12, atol=1e-14)
@@ -77,6 +89,48 @@ def test_eager_sp_matches_single_process():
     outs = mpi.run_ranks(body, sp)
     for loss in outs:
         np.testing.assert_allclose(loss, ref, rtol=1e-12)
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_remat_preserves_values_and_grads_on_mesh(moe):
+    """cfg.remat (jax.checkpoint per block) must be semantics-preserving:
+    identical loss and updated params on the distributed step, including
+    the re-executed in-block collectives (ring attention; with moe=True
+    also the expert-dispatch Alltoall over a 3D dp x sp x ep mesh)."""
+    params, tokens = setup()
+    if moe:
+        cfg = dataclasses.replace(CFG, n_experts=4, capacity=32,
+                                  aux_coef=0.0)
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        dp, sp, ep = 2, 2, 2
+    else:
+        cfg, (dp, sp, ep) = CFG, (2, 4, 1)
+
+    loss0, params0 = make_mesh_step(cfg, dp, sp, "ring", ep)(params, tokens)
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    loss1, params1 = make_mesh_step(cfg_r, dp, sp, "ring", ep)(params,
+                                                               tokens)
+
+    np.testing.assert_allclose(float(loss1), float(loss0), rtol=1e-12)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-10, atol=1e-12),
+        params1, params0)
+
+
+def test_remat_single_device_grads_match():
+    params, tokens = setup()
+    cfg_r = dataclasses.replace(CFG, remat=True)
+    l0, g0 = jax.value_and_grad(
+        lambda p: T.lm_loss(CFG, p, tokens))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: T.lm_loss(cfg_r, p, tokens))(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-12)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-10, atol=1e-12),
+        g1, g0)
 
 
 def test_forward_shapes_and_unknown_strategy():
