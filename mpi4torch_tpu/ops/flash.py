@@ -19,9 +19,11 @@ the primitive composes into context parallelism without renormalization
 error.  Fully-masked rows yield ``out = 0`` and ``lse = -BIG`` — the
 neutral element of the merge.
 
-Positions are passed as f32 offsets (exact to 2^24) so they may be
-*traced* values — under SPMD the block owner is rank-symbolic
-(``lax.axis_index`` arithmetic, SURVEY.md §7 hard part 4).
+Positions are passed as i32 offsets so they may be *traced* values —
+under SPMD the block owner is rank-symbolic (``lax.axis_index``
+arithmetic, SURVEY.md §7 hard part 4).  Integer positions are exact up
+to 2^31-1 total tokens (an earlier f32 encoding silently collided
+beyond 2^24 — the long-context regime this module exists for).
 
 Differentiable via ``jax.custom_vjp``: the backward recomputes the block
 scores (flash-style rematerialization; residuals are q/k/v/out/lse only)
@@ -48,14 +50,23 @@ _KV_TILE = 128
 _KV_VMEM_BUDGET = 8 * 1024 * 1024
 
 
+def _lane_pad(d: int) -> int:
+    """Head dim as staged in VMEM: the next lane multiple (128)."""
+    return 128 * ((d + 127) // 128)
+
+
 def _eligible(q, k) -> bool:
-    """Shapes the TPU kernel handles: head_dim a lane multiple, sequence
-    lengths divisible by their tile, staged KV within the VMEM budget."""
+    """Shapes the TPU kernel handles: sequence lengths divisible by their
+    tile and the staged KV within the VMEM budget.  head_dim need not be
+    a lane multiple — the kernel zero-pads it to the next multiple of 128
+    (d=64/96 pay ≤2x staged bytes, still far cheaper than the jnp path's
+    HBM score matrix).  d < 64 would waste >2x MXU/VMEM on padding, so
+    those shapes take the jnp fallback (XLA fuses them fine)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if d % 128 != 0:
+    if d < 64:
         return False
-    if 2 * sk * d * jnp.dtype(k.dtype).itemsize > _KV_VMEM_BUDGET:
+    if 2 * sk * _lane_pad(d) * jnp.dtype(k.dtype).itemsize > _KV_VMEM_BUDGET:
         return False
     qt = min(_Q_TILE, sq)
     kt = min(_KV_TILE, sk)
@@ -87,8 +98,8 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, ct))
     s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(ct), k.astype(ct)) * scale
     if causal:
-        q_pos = q_off.astype(ct) + jnp.arange(sq, dtype=ct)
-        kv_pos = kv_off.astype(ct) + jnp.arange(sk, dtype=ct)
+        q_pos = q_off + jnp.arange(sq, dtype=jnp.int32)
+        kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
         mask = q_pos[:, None] >= kv_pos[None, :]
         s = jnp.where(mask[None, :, None, :], s, NEG_BIG)
     m = jnp.max(s, axis=-1)
@@ -109,19 +120,22 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
 
 
 def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, causal: bool, kv_tile: int):
+                *, causal: bool, kv_tile: int, true_d: int):
     from jax.experimental import pallas as pl
 
     f32 = jnp.float32
+    i32 = jnp.int32
     qt, d = q_ref.shape[1], q_ref.shape[2]
     sk = k_ref.shape[1]
     n_kv = sk // kv_tile
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, f32))
+    # d is the lane-padded staging width; the softmax scale is the model's
+    # true head_dim (padded columns are zero and change no dot product).
+    scale = 1.0 / jnp.sqrt(jnp.asarray(true_d, f32))
 
     qb = q_ref[0].astype(f32) * scale                       # (QT, D)
     qi = pl.program_id(1)
     q_pos = (qoff_ref[0, 0] + qi * qt
-             + jax.lax.broadcasted_iota(f32, (qt, 1), 0))    # (QT, 1)
+             + jax.lax.broadcasted_iota(i32, (qt, 1), 0))    # (QT, 1)
 
     def body(j, carry):
         m, l, acc = carry
@@ -132,7 +146,7 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=f32)                      # (QT, KT)
         if causal:
             kv_pos = (kvoff_ref[0, 0] + j * kv_tile
-                      + jax.lax.broadcasted_iota(f32, (1, kv_tile), 1))
+                      + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
             mask = q_pos >= kv_pos                           # (QT, KT)
             s = jnp.where(mask, s, NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -167,38 +181,48 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
     bh = b * h
     qt = min(_Q_TILE, sq)
     kt = min(_KV_TILE, sk)
+    dp = _lane_pad(d)
 
     def to_bh(x, s):
-        return x.transpose(0, 2, 1, 3).reshape(bh, s, d)
+        x = x.transpose(0, 2, 1, 3).reshape(bh, s, d)
+        if dp != d:
+            # Zero-pad head_dim to the lane width.  Zeros leave every dot
+            # product unchanged (scores and PV columns), so only the
+            # output slice below is needed to undo it.
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, dp - d)))
+        return x
 
     qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
-    qoff = jnp.asarray(q_off, jnp.float32).reshape(1, 1)
-    kvoff = jnp.asarray(kv_off, jnp.float32).reshape(1, 1)
+    qoff = jnp.asarray(q_off, jnp.int32).reshape(1, 1)
+    kvoff = jnp.asarray(kv_off, jnp.int32).reshape(1, 1)
 
     grid = (bh, sq // qt)
     smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
     vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, causal=causal, kv_tile=kt),
+        functools.partial(_fwd_kernel, causal=causal, kv_tile=kt,
+                          true_d=d),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, dp), q.dtype),
             jax.ShapeDtypeStruct((bh, sq), jnp.float32),
         ),
         grid=grid,
         in_specs=[
             smem((1, 1), lambda i, j: (0, 0)),
             smem((1, 1), lambda i, j: (0, 0)),
-            vmem((1, qt, d), lambda i, j: (i, j, 0)),
-            vmem((1, sk, d), lambda i, j: (i, 0, 0)),
-            vmem((1, sk, d), lambda i, j: (i, 0, 0)),
+            vmem((1, qt, dp), lambda i, j: (i, j, 0)),
+            vmem((1, sk, dp), lambda i, j: (i, 0, 0)),
+            vmem((1, sk, dp), lambda i, j: (i, 0, 0)),
         ],
         out_specs=(
-            vmem((1, qt, d), lambda i, j: (i, j, 0)),
+            vmem((1, qt, dp), lambda i, j: (i, j, 0)),
             vmem((1, qt), lambda i, j: (i, j)),
         ),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb)
 
+    if dp != d:
+        out = out[:, :, :d]
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
     return out, lse
@@ -216,8 +240,8 @@ def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str):
         if not _eligible(q, k):
             raise ValueError(
                 f"impl='pallas' requires kernel-eligible shapes "
-                f"(head_dim % 128 == 0, tile-divisible sequence lengths, "
-                f"KV block within the VMEM budget); got q{q.shape} "
+                f"(head_dim >= 64, tile-divisible sequence lengths, KV "
+                f"block within the VMEM budget); got q{q.shape} "
                 f"k{k.shape} — use impl='auto' to fall back to jnp")
         return _pallas_block(q, k, v, q_off, kv_off, causal,
                              interpret=not _on_tpu())
@@ -279,8 +303,8 @@ def _block_bwd(causal, impl, res, cot):
     lse = lse.astype(f32)
     dlse = dlse.astype(f32)
     delta = jnp.sum(do * out.astype(f32), axis=-1)      # (b, q, h)
-    q_pos = q_off.astype(f32) + jnp.arange(sq, dtype=f32)
-    kv_pos = kv_off.astype(f32) + jnp.arange(sk, dtype=f32)
+    q_pos = q_off + jnp.arange(sq, dtype=jnp.int32)
+    kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
 
     kt = _KV_TILE
     if sk <= _BWD_TILE_ABOVE or sk % kt != 0:
@@ -304,21 +328,27 @@ def _block_bwd(causal, impl, res, cot):
             0, sk // kt, body,
             (jnp.zeros_like(qf), jnp.zeros_like(kf), jnp.zeros_like(vf)))
 
+    # Offsets are integer primals: their cotangent type is float0 (the
+    # symbolic-zero tangent dtype JAX mandates for non-inexact inputs).
+    import numpy as np
+
+    zero_off = np.zeros(jnp.shape(q_off), jax.dtypes.float0)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            jnp.zeros_like(jnp.asarray(q_off, f32)),
-            jnp.zeros_like(jnp.asarray(kv_off, f32)))
+            zero_off, zero_off)
 
 
 _block.defvjp(_block_fwd, _block_bwd)
 
 
-def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0.0,
-                          kv_offset=0.0, impl: str = "auto"
+def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
+                          kv_offset=0, impl: str = "auto"
                           ) -> Tuple[jax.Array, jax.Array]:
     """Normalized attention partials of ``q`` against one KV block.
 
-    Args are ``(batch, seq, heads, head_dim)``; offsets are the global
-    positions of the first query/key (may be traced).  Returns
+    Args are ``(batch, seq, heads, head_dim)``; offsets are the *integer*
+    global positions of the first query/key (may be traced; exact to
+    2^31-1 — float inputs are truncated to int32, losing exactness past
+    2^24 before the cast).  Returns
     ``(out, lse)`` with ``out`` of ``q``'s shape/dtype and ``lse`` of shape
     ``(batch, seq_q, heads)`` in the compute dtype (f32, or f64 under x64
     on the jnp path).  ``impl``: ``"auto"`` (Pallas on
@@ -326,8 +356,8 @@ def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0.0,
     TPU — for tests), ``"jnp"``."""
     if impl not in ("auto", "pallas", "jnp"):
         raise ValueError(f"unknown impl {impl!r}")
-    q_off = jnp.asarray(q_offset, jnp.float32)
-    kv_off = jnp.asarray(kv_offset, jnp.float32)
+    q_off = jnp.asarray(q_offset, jnp.int32)
+    kv_off = jnp.asarray(kv_offset, jnp.int32)
     return _block(q, k, v, q_off, kv_off, causal, impl)
 
 

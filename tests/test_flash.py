@@ -131,9 +131,9 @@ class TestPallasKernel:
             return flash.flash_block_attention(
                 q, k, v, causal=True, q_offset=off, impl="pallas")[0]
 
-        got = f(jnp.asarray(float(PS)))
+        got = f(jnp.asarray(PS))
         ref, _ = flash.flash_block_attention(q, k, v, causal=True,
-                                             q_offset=float(PS), impl="jnp")
+                                             q_offset=PS, impl="jnp")
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-6)
 
@@ -156,11 +156,98 @@ class TestPallasKernel:
                                        rtol=1e-4, atol=1e-5)
 
 
+class TestLanePadding:
+    """head_dim 64/96 take the kernel via zero-padding to the 128 lane
+    width (round-1 gap: the common d=64 silently fell back to jnp)."""
+
+    @pytest.mark.parametrize("d", [64, 96])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_padded_head_dim_matches_jnp(self, d, causal):
+        q, k, v = qkv((1, 256, 2, d), dtype=jnp.float32)
+        assert flash._eligible(q, k)
+        a, la = flash.flash_block_attention(q, k, v, causal=causal,
+                                            impl="pallas")
+        b, lb = flash.flash_block_attention(q, k, v, causal=causal,
+                                            impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_padded_head_dim_grads_match(self):
+        q, k, v = qkv((1, 128, 2, 64), dtype=jnp.float32)
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(flash.flash_block_attention(
+                q, k, v, causal=True, impl=impl)[0] ** 2)
+
+        ga = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+
+class TestIntegerPositions:
+    def test_positions_exact_beyond_f32_range(self):
+        # Query block at position 2^24 against one key at 2^24 + 1.  The
+        # earlier f32 position encoding rounded both to 2^24, unmasking
+        # the future key for row 0; i32 positions keep the frontier exact
+        # (the long-context correctness cliff, ADVICE round 1).
+        big = 2 ** 24
+        q, k, v = qkv((1, 8, 1, D))
+        o, lse = flash.flash_block_attention(
+            q, k[:, :1], v[:, :1], causal=True, q_offset=big,
+            kv_offset=big + 1, impl="jnp")
+        assert float(lse[0, 0, 0]) == flash.NEG_BIG     # masked
+        np.testing.assert_array_equal(np.asarray(o[0, 0]), 0.0)
+        assert np.all(np.asarray(lse[0, 1:]) > flash.NEG_BIG)  # visible
+
+    def test_pallas_positions_exact_beyond_f32_range(self):
+        # Same frontier exactness through the kernel's i32 SMEM offsets +
+        # iota path (interpret mode): an f32 regression there would
+        # unmask future keys only at long-context offsets.
+        big = 2 ** 24
+        q, k, v = qkv((1, 128, 1, 64), dtype=jnp.float32)
+        a, la = flash.flash_block_attention(
+            q, k, v, causal=True, q_offset=big, kv_offset=big + 1,
+            impl="pallas")
+        b, lb = flash.flash_block_attention(
+            q, k, v, causal=True, q_offset=big, kv_offset=big + 1,
+            impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+        # Row 0 sees no keys (first key is one position in its future).
+        assert float(la[0, 0, 0]) <= -1e29
+        assert float(lb[0, 0, 0]) <= -1e29
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="compiled (non-interpret) kernel needs a TPU")
+class TestCompiledKernelOnTPU:
+    """Hardware gate: the non-interpret Pallas kernel vs the jnp oracle.
+
+    Skipped on the CPU-mesh CI harness (conftest pins the cpu platform);
+    run on hardware via ``JAX_PLATFORMS= python -m pytest tests/test_flash.py
+    -k Compiled`` — the driver's bench.py exercises the same compiled
+    kernel through impl='auto'."""
+
+    @pytest.mark.parametrize("d", [64, 128])
+    def test_compiled_matches_jnp(self, d):
+        q, k, v = qkv((2, 512, 4, d), dtype=jnp.float32)
+        a, la = flash.flash_block_attention(q, k, v, causal=True,
+                                            impl="pallas")
+        b, lb = flash.flash_block_attention(q, k, v, causal=True,
+                                            impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 class TestEligibility:
     def test_auto_falls_back_on_small_head_dim(self):
         q, k, v = qkv((B, S, H, D))
-        # D=8 is not lane-aligned: auto must take the jnp path (and agree
-        # with it bit-for-bit).
+        # D=8 is below the padded-lane cutoff: auto must take the jnp path
+        # (and agree with it bit-for-bit).
         assert not flash._eligible(q, k)
         a, la = flash.flash_block_attention(q, k, v, causal=True)
         b, lb = flash.flash_block_attention(q, k, v, causal=True,
