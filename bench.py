@@ -145,11 +145,12 @@ def _bench_flash(on_tpu: bool, peak: float):
     step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
     dt = _timeit(step, q, k, v, iters=iters)
 
-    # Causal fwd = 2 matmuls * 2 FLOP/MAC * B*H*S^2*D / 2 (masked half);
-    # flash backward recomputes scores and adds dq/dk/dv/dp matmuls:
-    # ~2.5x forward, plus the extra forward recompute -> 3.5x total.
+    # Causal fwd = 2 matmuls * 2 FLOP/MAC * B*H*S^2*D / 2 (masked half).
+    # MFU uses *model* FLOPs only (PaLM convention): fwd + 2x bwd = 3x;
+    # the flash backward's score recompute is excluded (that extra work
+    # would make this HFU and overstate utilization).
     fwd = 2.0 * b * h * s * s * d
-    flops = 3.5 * fwd
+    flops = 3.0 * fwd
     achieved = flops / dt
     kernel_engaged = bool(
         on_tpu and flash._eligible(q, k))
@@ -197,8 +198,9 @@ def _bench_train_step(on_tpu: bool, peak: float):
     n_tokens = batch * cfg.max_seq
     s, hd = cfg.max_seq, cfg.d_model // cfg.n_heads
     # 6*N*T dense accounting + causal attention matmuls (fwd 2*2*B*H*S^2*
-    # Dh/2 per layer, x3.5 for fwd+bwd as in _bench_flash).
-    attn = 3.5 * 2.0 * batch * cfg.n_heads * s * s * hd * cfg.n_layers
+    # Dh/2 per layer, x3 for fwd+bwd model FLOPs — recompute excluded,
+    # as in _bench_flash).
+    attn = 3.0 * 2.0 * batch * cfg.n_heads * s * s * hd * cfg.n_layers
     flops = 6.0 * n_params * n_tokens + attn
     achieved = flops / dt
     return {

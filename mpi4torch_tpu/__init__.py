@@ -49,7 +49,7 @@ from .runtime import (
     InPlaceReuseError,
     run_ranks,
 )
-from .ops.spmd import RankExpr, run_spmd
+from .ops.spmd import RankExpr, p2p_scope, run_spmd
 from . import config
 
 __all__ = [
@@ -76,6 +76,7 @@ __all__ = [
     # TPU-native additions
     "comm_from_mesh",
     "run_ranks",
+    "p2p_scope",
     "run_spmd",
     "RankExpr",
     "config",

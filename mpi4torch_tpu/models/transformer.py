@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from ..constants import MPI_SUM
 from ..ops.flash import flash_attention
-from ..parallel.attention import dense_attention, ring_attention, \
+from ..parallel.attention import ring_attention, \
     ulysses_attention
 from ..parallel.dp import all_average_tree
 from ..parallel.moe import init_moe, moe_ffn, moe_ffn_dense

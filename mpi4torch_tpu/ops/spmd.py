@@ -46,6 +46,7 @@ served by the eager runtime or by padding+masking at the user level.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -747,10 +748,18 @@ def comm_from_mesh(mesh, axis_name: str):
 
     def resolver():
         ctx = current_spmd_context()
-        if ctx is not None and ctx.axis_name == axis_name:
+        # Size must match too: two meshes can reuse an axis *name* with
+        # different extents, and adopting the other mesh's context would
+        # silently misroute ring arithmetic.
+        if (ctx is not None and ctx.axis_name == axis_name
+                and ctx.size == size):
             return SpmdBackend(ctx)
-        from jax._src.core import trace_ctx
-        trace = trace_ctx.trace
+        # Public re-export (jax.core, no private-module import): the
+        # active trace keys the per-region context.
+        # jax.core.get_opaque_trace_state() wraps the same object but
+        # hides it behind an opaque unhashable type, so the trace itself
+        # stays the weak key here.
+        trace = jax.core.trace_ctx.trace
         ctx = trace_contexts.get(trace)
         if ctx is None:
             ctx = SpmdContext(axis_name=axis_name, size=size)
@@ -762,7 +771,39 @@ def comm_from_mesh(mesh, axis_name: str):
                 pass  # non-weakrefable trace: fall back to per-call context
         return SpmdBackend(ctx)
 
-    return MPI_Communicator(resolver)
+    comm = MPI_Communicator(resolver)
+    comm._spmd_axis = (axis_name, size)
+    return comm
+
+
+@contextlib.contextmanager
+def p2p_scope(comm):
+    """Raising p2p-matching scope for *user-managed* ``shard_map`` regions.
+
+    ``run_spmd`` raises :class:`DeadlockError` when a region ends with
+    unmatched Isend/Irecv; a user-managed region has no exit hook, so by
+    default the mesh communicator can only print a finalizer warning when
+    the trace dies.  Wrapping the communication in ``with
+    p2p_scope(comm):`` restores the hard guarantee — unmatched
+    point-to-point operations raise at scope exit, at trace time::
+
+        def body(x):
+            with mpi.p2p_scope(comm):
+                h = comm.Isend(x, dst, tag=0)
+                y = comm.Recv(jnp.zeros_like(x), src, tag=0)
+                comm.Wait(h)
+            return y
+        jax.jit(shard_map(body, mesh=mesh, ...))(x)
+    """
+    axis = getattr(comm, "_spmd_axis", None)
+    if axis is None:
+        raise CommError(
+            "p2p_scope requires a mesh-derived communicator "
+            "(comm_from_mesh); COMM_WORLD inside run_spmd already has a "
+            "raising scope")
+    ctx = SpmdContext(axis_name=axis[0], size=axis[1])
+    with _bind_spmd(ctx):
+        yield comm
 
 
 DEFAULT_AXIS = "mpi"

