@@ -13,7 +13,8 @@ ring, with a jnp fallback for ineligible shapes/platforms.
 """
 
 from .flash import flash_attention, flash_block_attention, merge_partials
-from .ragged import ragged_allgather, ragged_alltoall, segment_mask
+from .ragged import (ragged_allgather, ragged_alltoall, ragged_gather,
+                     ragged_scatter, segment_mask)
 
 __all__ = [
     "flash_attention",
@@ -21,5 +22,7 @@ __all__ = [
     "merge_partials",
     "ragged_allgather",
     "ragged_alltoall",
+    "ragged_gather",
+    "ragged_scatter",
     "segment_mask",
 ]

@@ -39,6 +39,45 @@ def _masked(x, counts, capacity: int):
     return jnp.where(m != 0, x, jnp.zeros((), x.dtype))
 
 
+def _validated_rowblock(opname: str, x, size: int) -> int:
+    """Check a ``(size, capacity, *feat)`` per-destination block; return
+    the capacity."""
+    if x.ndim < 2 or x.shape[0] != size:
+        raise ValueError(
+            f"{opname} expects x of shape (size={size}, capacity, *feat); "
+            f"got {x.shape}")
+    return x.shape[1]
+
+
+def _validated_counts_vector(opname: str, counts, size: int, capacity: int):
+    """Check a ``(size,)`` counts vector; clamp to [0, capacity] so the
+    transmitted counts always agree with what the mask lets through — an
+    out-of-range count would otherwise arrive inconsistent with the
+    zero-padded valid data."""
+    counts = jnp.asarray(counts)
+    if counts.shape != (size,):
+        raise ValueError(
+            f"{opname}: counts must have shape ({size},); got "
+            f"{counts.shape}")
+    return jnp.clip(counts, 0, capacity)
+
+
+def _validated_scalar_count(opname: str, x, count):
+    """Check a ``(capacity, *feat)`` payload + scalar count; return
+    ``(capacity, clamped count)``."""
+    if x.ndim < 1:
+        raise ValueError(
+            f"{opname} expects x of shape (capacity, *feat); got {x.shape}")
+    capacity = x.shape[0]
+    count = jnp.asarray(count)
+    if count.ndim != 0:
+        raise ValueError(
+            f"{opname}: count must be a scalar (this rank's valid length); "
+            f"got shape {count.shape} — per-destination counts belong to "
+            "ragged_alltoall")
+    return capacity, jnp.clip(count, 0, capacity)
+
+
 def ragged_alltoall(comm, x, send_counts) -> Tuple:
     """All-to-all with per-destination-varying segment sizes (the
     MPI_Alltoallv analogue; reference's same-axis Alltoall with varying
@@ -54,19 +93,9 @@ def ragged_alltoall(comm, x, send_counts) -> Tuple:
     slots get zero gradient (they are masked before the exchange, so the
     adjoint exchange routes nothing into them)."""
     size = comm.size
-    if x.ndim < 2 or x.shape[0] != size:
-        raise ValueError(
-            f"ragged_alltoall expects x of shape (size={size}, capacity, "
-            f"*feat); got {x.shape}")
-    capacity = x.shape[1]
-    send_counts = jnp.asarray(send_counts)
-    if send_counts.shape != (size,):
-        raise ValueError(
-            f"send_counts must have shape ({size},); got {send_counts.shape}")
-    # Clamp to [0, capacity] so the transmitted counts always agree with
-    # what the mask lets through — an out-of-range count would otherwise
-    # arrive as a recv_count inconsistent with the zero-padded valid data.
-    send_counts = jnp.clip(send_counts, 0, capacity)
+    capacity = _validated_rowblock("ragged_alltoall", x, size)
+    send_counts = _validated_counts_vector("ragged_alltoall send_counts",
+                                           send_counts, size, capacity)
 
     xz = _masked(x, send_counts, capacity)
     # Gather sources along a fresh axis, keep my destination block:
@@ -89,19 +118,60 @@ def ragged_allgather(comm, x, count) -> Tuple:
     zeroed — and ``counts`` is ``(size,)``.  ``jnp.concatenate`` of the
     per-rank valid prefixes reconstructs the reference's exact Allgatherv
     result (see tests)."""
-    if x.ndim < 1:
-        raise ValueError(
-            f"ragged_allgather expects x of shape (capacity, *feat); got "
-            f"{x.shape}")
-    capacity = x.shape[0]
-    count = jnp.asarray(count)
-    if count.ndim != 0:
-        raise ValueError(
-            f"count must be a scalar (this rank's valid length); got shape "
-            f"{count.shape} — per-destination counts belong to "
-            "ragged_alltoall")
-    count = jnp.clip(count, 0, capacity)
+    capacity, count = _validated_scalar_count("ragged_allgather", x, count)
     xz = _masked(x, count, capacity)
     gathered = comm.Allgather(xz[None], gatheraxis=0)
     counts = comm.Allgather(count[None], gatheraxis=0)
     return gathered, counts
+
+
+def ragged_gather(comm, x, count, root: int = 0) -> Tuple:
+    """Gather-to-root with per-rank-varying valid lengths (the MPI_Gatherv
+    analogue; reference's Gather with varying shard sizes,
+    csrc/extension.cpp:540-577 + tests/test_collectives.py varying
+    ``numelem``).
+
+    ``x``: ``(capacity, *feat)`` with the first ``count`` rows valid
+    (``count`` may differ per rank and may be traced).  Returns
+    ``(gathered, counts)``: on the root, ``gathered`` is ``(size,
+    capacity, *feat)`` — rank ``s``'s padded block at index ``s``,
+    invalid slots zeroed — and ``counts`` is ``(size,)``; on non-roots
+    both are zeros of the same shapes (the reference's zeroed-non-root
+    convention).  ``jnp.concatenate`` of the valid prefixes on the root
+    reconstructs MPI_Gatherv's packed result (see tests).
+    Differentiable in ``x``: the adjoint routes cotangents back through
+    the scatter, and padding slots get zero gradient."""
+    capacity, count = _validated_scalar_count("ragged_gather", x, count)
+    xz = _masked(x, count, capacity)
+    gathered = comm.Gather(xz[None], gatheraxis=0, root=root)
+    counts = comm.Gather(count[None], gatheraxis=0, root=root)
+    return gathered, counts
+
+
+def ragged_scatter(comm, x, counts, root: int = 0) -> Tuple:
+    """Scatter-from-root with per-receiver-varying valid lengths (the
+    MPI_Scatterv analogue; reference's Scatter with per-rank ``numelem``,
+    csrc/extension.cpp:819-871, tests/test_collectives.py:121-125).
+
+    ``x`` (meaningful on the root): ``(size, capacity, *feat)`` — row
+    block ``i`` goes to rank ``i``.  ``counts`` (meaningful on the root):
+    ``(size,)`` valid lengths, one per receiver — like MPI_Scatterv's
+    root-side ``sendcounts``, non-root values are ignored and learned
+    from the root.  Returns ``(recv, my_count)``: this rank's
+    ``(capacity, *feat)`` block with slots beyond ``my_count`` zeroed.
+    Inverse of :func:`ragged_gather` on the valid prefixes.
+    Differentiable in ``x``; padding slots never leak gradient."""
+    size = comm.size
+    capacity = _validated_rowblock("ragged_scatter", x, size)
+    counts = _validated_counts_vector("ragged_scatter", counts, size,
+                                      capacity)
+    # Receivers learn their count from the root (MPI_Scatterv packs this
+    # into recvcount; here the whole counts row rides one small Bcast_).
+    # i32 is the wire format only: my_count comes back in the caller's
+    # count dtype so gather->scatter round trips keep their dtype.
+    wire = comm.Bcast_(counts.astype(jnp.int32), root=root)
+    my_count = jnp.take(wire, jnp.asarray(comm.rank), axis=0).astype(
+        counts.dtype)
+    recv = comm.Scatter(x, scatteraxis=0, numelem=1, root=root)
+    recv = recv.reshape((capacity,) + x.shape[2:])
+    return _masked(recv, my_count, capacity), my_count

@@ -13,7 +13,8 @@ import pytest
 
 import mpi4torch_tpu as mpi
 from mpi4torch_tpu import COMM_WORLD as comm
-from mpi4torch_tpu.ops import ragged_allgather, ragged_alltoall, segment_mask
+from mpi4torch_tpu.ops import (ragged_allgather, ragged_alltoall,
+                               ragged_gather, ragged_scatter, segment_mask)
 
 NR = 4
 CAP = 5
@@ -220,3 +221,156 @@ class TestRobustness:
 
         for c in mpi.run_ranks(body, NR):
             np.testing.assert_array_equal(c, np.full(NR, CAP))
+
+
+# Root-varying Gatherv/Scatterv (reference: varying ``numelem`` cases,
+# tests/test_collectives.py:121-125, csrc/extension.cpp:540-577, 839-871).
+GLENS = np.array([2, 0, 3, 1])          # per-rank valid lengths
+ROOT = 2
+
+
+def gv_payload(r):
+    """Rank r's padded block: row i carries 10*r + i; padding poisoned."""
+    x = np.full((CAP, FEAT), -999.0)
+    for i in range(GLENS[r]):
+        x[i, :] = 10 * r + i
+    return jnp.asarray(x)
+
+
+def gv_expected():
+    g = np.zeros((NR, CAP, FEAT))
+    for r in range(NR):
+        for i in range(GLENS[r]):
+            g[r, i, :] = 10 * r + i
+    return g
+
+
+def packed(gathered, counts):
+    """MPI_Gatherv's packed result: concatenated valid prefixes."""
+    return np.concatenate([np.asarray(gathered)[s, :c]
+                           for s, c in enumerate(np.asarray(counts))])
+
+
+class TestRaggedGatherScatter:
+    def test_eager_gather_matches_oracle(self):
+        def body():
+            r = int(comm.rank)
+            g, c = ragged_gather(comm, gv_payload(r),
+                                 jnp.asarray(GLENS)[r], root=ROOT)
+            return np.asarray(g), np.asarray(c)
+
+        outs = mpi.run_ranks(body, NR)
+        g_root, c_root = outs[ROOT]
+        np.testing.assert_array_equal(g_root, gv_expected())
+        np.testing.assert_array_equal(c_root, GLENS)
+        ref_packed = np.concatenate(
+            [np.asarray(gv_payload(r))[:GLENS[r]] for r in range(NR)])
+        np.testing.assert_array_equal(packed(g_root, c_root), ref_packed)
+        for r, (g, c) in enumerate(outs):
+            if r != ROOT:
+                np.testing.assert_array_equal(g, 0.0)   # zeroed non-root
+                np.testing.assert_array_equal(c, 0)
+
+    def test_spmd_gather_matches_eager(self):
+        lens = jnp.asarray(GLENS)
+
+        def body():
+            r = jnp.asarray(comm.rank)
+            x = jnp.where(
+                jnp.arange(CAP)[:, None] < lens[r],
+                (10.0 * r + jnp.arange(CAP))[:, None]
+                * jnp.ones((CAP, FEAT)),
+                -999.0)
+            return ragged_gather(comm, x, lens[r], root=ROOT)
+
+        g, c = mpi.run_spmd(body, nranks=NR)()
+        np.testing.assert_array_equal(np.asarray(g)[ROOT], gv_expected())
+        np.testing.assert_array_equal(np.asarray(c)[ROOT], GLENS)
+        for r in range(NR):
+            if r != ROOT:
+                np.testing.assert_array_equal(np.asarray(g)[r], 0.0)
+
+    @pytest.mark.parametrize("backend", ["eager", "spmd"])
+    def test_scatter_inverts_gather_on_valid_prefixes(self, backend):
+        # Scatterv(Gatherv(x)) == x on valid slots, zeros on padding —
+        # the reference's Scatter∘Gather identity with varying numelem.
+        lens = jnp.asarray(GLENS)
+
+        def body():
+            r = jnp.asarray(comm.rank)
+            x = jnp.where(
+                jnp.arange(CAP)[:, None] < lens[r],
+                (10.0 * r + jnp.arange(CAP))[:, None]
+                * jnp.ones((CAP, FEAT)),
+                -999.0)
+            g, c = ragged_gather(comm, x, lens[r], root=ROOT)
+            recv, mc = ragged_scatter(comm, g, c, root=ROOT)
+            return recv, mc
+
+        if backend == "eager":
+            outs = mpi.run_ranks(lambda: tuple(
+                np.asarray(t) for t in body()), NR)
+        else:
+            recv, mc = mpi.run_spmd(body, nranks=NR)()
+            outs = [(np.asarray(recv)[r], np.asarray(mc)[r])
+                    for r in range(NR)]
+        for r, (recv, mc) in enumerate(outs):
+            np.testing.assert_array_equal(mc, GLENS[r])
+            expect = np.zeros((CAP, FEAT))
+            for i in range(GLENS[r]):
+                expect[i, :] = 10 * r + i
+            np.testing.assert_array_equal(recv, expect)
+
+    def test_gather_grads_route_back_padding_zero(self):
+        lens = jnp.asarray(GLENS)
+
+        def body():
+            r = int(comm.rank)
+
+            def loss(x):
+                g, _ = ragged_gather(comm, x, lens[r], root=ROOT)
+                return jnp.sum(g * 2.0)
+
+            return np.asarray(jax.grad(loss)(jnp.ones((CAP, FEAT))))
+
+        for r, grad in enumerate(mpi.run_ranks(body, NR)):
+            expect = np.zeros((CAP, FEAT))
+            expect[:GLENS[r]] = 2.0       # valid slots see the cotangent
+            np.testing.assert_array_equal(grad, expect)
+
+    def test_scatter_grads_route_back_padding_zero(self):
+        lens = jnp.asarray(GLENS)
+
+        def body():
+            r = int(comm.rank)
+
+            def loss(x):
+                recv, _ = ragged_scatter(comm, x, lens, root=ROOT)
+                return jnp.sum(recv * 3.0)
+
+            return np.asarray(jax.grad(loss)(jnp.ones((NR, CAP, FEAT))))
+
+        grads = mpi.run_ranks(body, NR)
+        expect_root = np.zeros((NR, CAP, FEAT))
+        for r in range(NR):
+            expect_root[r, :GLENS[r]] = 3.0
+        np.testing.assert_array_equal(grads[ROOT], expect_root)
+        for r, g in enumerate(grads):
+            if r != ROOT:
+                np.testing.assert_array_equal(g, 0.0)  # root-only input
+
+    def test_shape_validation(self):
+        def body():
+            with pytest.raises(ValueError, match="capacity"):
+                ragged_gather(comm, jnp.asarray(0.0), 1)
+            with pytest.raises(ValueError, match="scalar"):
+                ragged_gather(comm, jnp.zeros((CAP,)), jnp.zeros((2,)))
+            with pytest.raises(ValueError, match="size"):
+                ragged_scatter(comm, jnp.zeros((NR + 1, CAP)),
+                               jnp.zeros((NR,)))
+            with pytest.raises(ValueError, match="shape"):
+                ragged_scatter(comm, jnp.zeros((NR, CAP)),
+                               jnp.zeros((NR + 1,)))
+            return True
+
+        assert all(mpi.run_ranks(body, NR))
