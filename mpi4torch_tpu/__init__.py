@@ -50,6 +50,14 @@ from .runtime import (
     run_ranks,
 )
 from .ops.spmd import RankExpr, p2p_scope, run_spmd
+from .distributed import (
+    DistributedInfo,
+    distributed_info,
+    finalize_distributed,
+    init_distributed,
+    is_distributed,
+    local_values,
+)
 from . import config
 
 __all__ = [
@@ -78,6 +86,12 @@ __all__ = [
     "run_ranks",
     "p2p_scope",
     "run_spmd",
+    "DistributedInfo",
+    "distributed_info",
+    "finalize_distributed",
+    "init_distributed",
+    "is_distributed",
+    "local_values",
     "RankExpr",
     "config",
     "CommError",

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 
 from . import constants as C
 from .ops import eager as _eager
-from .runtime import RankContext, current_rank_context, effective_rank_context
+from .runtime import (CommError, RankContext, current_rank_context,
+                      effective_rank_context)
 
 
 class WaitHandle:
@@ -284,14 +285,21 @@ class _EagerBackend:
         return _eager.wait(self._ctx, handle)
 
 
-def _default_resolver():
-    """COMM_WORLD backend resolution: active SPMD trace context first, then
-    the current rank-thread, then the size-1 default world."""
+def _contextual_resolver(fallback):
+    """Shared resolution policy: active SPMD trace context first, then the
+    caller's fallback backend."""
     spmd_ctx = _spmd_context()
     if spmd_ctx is not None and current_rank_context() is None:
         from .ops import spmd as _spmd
         return _spmd.SpmdBackend(spmd_ctx)
-    return _EagerBackend(effective_rank_context())
+    return fallback()
+
+
+def _default_resolver():
+    """COMM_WORLD backend resolution: active SPMD trace context first, then
+    the current rank-thread, then the size-1 default world."""
+    return _contextual_resolver(
+        lambda: _EagerBackend(effective_rank_context()))
 
 
 def _restore_comm_world():
@@ -317,21 +325,130 @@ def comm_from_mesh(mesh, axis_name: str) -> MPI_Communicator:
     return _spmd.comm_from_mesh(mesh, axis_name)
 
 
-def comm_from_mpi4py(comm) -> MPI_Communicator:
-    """Convert an mpi4py communicator (reference: src/__init__.py:247-261).
+class _ProcessWorldBackend:
+    """Top-level backend of an mpi4py-derived communicator under an MPI
+    launch of more than one process: rank/size report the MPI layout;
+    collective ops require an SPMD region (each OS process is a separate
+    Python program — only a compiled program over the global mesh spans
+    them)."""
 
-    Provided for API parity: this framework replaces the MPI process group
-    with a JAX device mesh, so mpi4py interop only applies when mpi4py is
-    co-installed and the process layout matches; otherwise use
-    :func:`comm_from_mesh`."""
+    def __init__(self, rank: int, size: int):
+        self.rank = rank
+        self.size = size
+
+    def __getattr__(self, name):
+        raise CommError(
+            "this mpi4py-derived communicator spans OS processes; run its "
+            "collectives inside run_spmd (the compiled SPMD program over "
+            "the global device mesh), not at the top level of one process"
+        )
+
+
+def comm_from_mpi4py(comm) -> MPI_Communicator:
+    """Convert an mpi4py communicator (reference: src/__init__.py:247-261,
+    csrc/extension.cpp:168-171 — there via the Fortran handle; here via
+    the coordination-service rendezvous).
+
+    Under an MPI launch (``mpirun -np N python prog.py`` with mpi4py),
+    this bootstraps the JAX multi-process runtime *from the MPI world*:
+    rank 0 opens a coordinator port and broadcasts ``host:port`` over the
+    mpi4py communicator, every rank joins via
+    :func:`~mpi4torch_tpu.init_distributed`, and the returned
+    communicator reports the MPI rank/size at the top level while its
+    collectives run over the global device mesh inside ``run_spmd``
+    regions.  With a single MPI process the default world already
+    matches, so the returned communicator is immediately usable (the
+    reference interop test's shape).  Raises ``RuntimeError`` when
+    mpi4py is absent (reference: src/__init__.py:255-258) and
+    :class:`CommError` when the established JAX process layout disagrees
+    with the MPI world."""
     try:
         from mpi4py import MPI as _MPI  # noqa: F401
     except ModuleNotFoundError:
         raise RuntimeError("mpi4py is not available!")
-    raise RuntimeError(
-        "mpi4py interop requires an MPI-launched process layout; use "
-        "comm_from_mesh(mesh, axis_name) to adopt a JAX mesh instead"
-    )
+
+    from . import distributed as _dist
+
+    rank, size = comm.Get_rank(), comm.Get_size()
+    if size == 1:
+        info = _dist.distributed_info()
+        if info is not None and info.process_count > 1:
+            # COMM_SELF (or another size-1 subcommunicator) inside a
+            # multi-process launch: the default world spans ALL
+            # processes, so returning it would silently widen rank-local
+            # collectives across the launch.
+            raise CommError(
+                "a size-1 mpi4py communicator inside a "
+                f"{info.process_count}-process launch is a "
+                "subcommunicator; only world-spanning communicators map "
+                "onto the global device mesh — split the mesh with "
+                "comm_from_mesh for subgroup collectives")
+        # One process: the contextual world (size-1 eager, or whatever
+        # mesh a surrounding SPMD region provides) is already the MPI
+        # world; ops work immediately, like the reference's.
+        return MPI_Communicator()
+
+    if not _dist.is_distributed():
+        if rank == 0:
+            addr = f"{_routable_ip()}:{_free_port()}"
+        else:
+            addr = None
+        addr = comm.bcast(addr, root=0)
+        _dist.init_distributed(coordinator_address=addr,
+                               num_processes=size, process_id=rank)
+    info = _dist.distributed_info()
+    if info.process_count != size:
+        raise CommError(
+            f"mpi4py world has {size} processes but the JAX runtime was "
+            f"initialized with {info.process_count}; launch both with the "
+            "same layout")
+    if info.process_id != rank:
+        raise CommError(
+            f"mpi4py rank {rank} does not match the JAX process_id "
+            f"{info.process_id}; a rank-reordered communicator would "
+            "silently misattribute SPMD ranks — pass the communicator "
+            "whose ordering matches the launch (usually MPI.COMM_WORLD)")
+    backend = _ProcessWorldBackend(rank, size)
+    return MPI_Communicator(lambda: _contextual_resolver(lambda: backend))
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _routable_ip() -> str:
+    """Best-effort address other hosts can reach for the rendezvous.
+
+    ``MPI4TORCH_TPU_COORDINATOR_HOST`` overrides.  The UDP-connect trick
+    learns the egress interface without sending a packet;
+    ``gethostbyname(hostname)`` often maps to 127.0.0.1 in containers,
+    which would hang a multi-host rendezvous, so it is the last resort
+    (fine for single-host oversubscribed launches, the CI analogue)."""
+    import os
+    import socket
+
+    override = os.environ.get("MPI4TORCH_TPU_COORDINATOR_HOST")
+    if override:
+        return override
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
 
 
 def deactivate_cuda_aware_mpi_support() -> None:

@@ -1,0 +1,156 @@
+"""Multi-process runtime: the ``mpirun`` / ``MPI_Init`` analogue.
+
+The reference's deployment model is N OS processes under a launcher whose
+rendezvous is ``MPI_Init_thread`` at import (csrc/extension.cpp:1313-1394,
+CI ``mpirun -np N``).  The TPU-native analogue: N Python processes (one
+per host) rendezvous through JAX's coordination service
+(``jax.distributed.initialize``); after that, ``jax.devices()`` is the
+*global* device set, one jitted SPMD program spans every process, and
+collectives ride ICI/DCN on TPU pods (gloo on the CPU test harness —
+the ``mpirun --oversubscribe`` analogue, SURVEY.md §4).
+
+Unlike MPI, initialization is explicit rather than at import: JAX
+requires the rendezvous before the backend first initializes, and
+import-time network calls would hang every single-process user.  The
+launcher contract is otherwise the reference's: every process calls
+:func:`init_distributed` with its own ``process_id``, then runs the same
+SPMD program (e.g. via :func:`mpi4torch_tpu.run_spmd`, whose default
+mesh — all of ``jax.devices()`` — is now the global one, so
+``COMM_WORLD`` spans processes with no further wiring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .runtime import CommError
+
+_STATE = {"info": None}
+
+
+@dataclass(frozen=True)
+class DistributedInfo:
+    """What the rendezvous established (returned by
+    :func:`init_distributed`)."""
+    process_id: int
+    process_count: int
+    n_devices: int          # global device count == COMM_WORLD size in SPMD
+    n_local_devices: int
+    coordinator_address: Optional[str]
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None) -> DistributedInfo:
+    """Join the multi-process world (reference init rendezvous,
+    csrc/extension.cpp:1313-1394).
+
+    All arguments are optional: on managed clusters (SLURM, TPU pods)
+    JAX auto-detects the layout; an explicit launcher passes
+    ``coordinator_address="host:port"``, ``num_processes`` and this
+    process's ``process_id``.  Must be called before the first JAX
+    computation.  Idempotent per process: a second call returns the
+    existing :class:`DistributedInfo` (and raises if its arguments
+    disagree with the established layout)."""
+    import jax
+
+    if _STATE["info"] is not None:
+        info = _STATE["info"]
+        if ((num_processes is not None
+             and num_processes != info.process_count)
+                or (process_id is not None
+                    and process_id != info.process_id)):
+            raise CommError(
+                f"init_distributed was already called with "
+                f"process_id={info.process_id}/"
+                f"num_processes={info.process_count}; cannot re-initialize "
+                f"as process_id={process_id}/num_processes={num_processes}")
+        return info
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:
+        raise CommError(
+            f"multi-process rendezvous failed: {e}\n"
+            "init_distributed must run before the first JAX computation, "
+            "and every process of the launch must call it with the same "
+            "coordinator_address and num_processes") from e
+
+    info = DistributedInfo(
+        process_id=jax.process_index(),
+        process_count=jax.process_count(),
+        n_devices=len(jax.devices()),
+        n_local_devices=len(jax.local_devices()),
+        coordinator_address=coordinator_address,
+    )
+    _STATE["info"] = info
+    return info
+
+
+def finalize_distributed() -> None:
+    """Leave the multi-process world (the reference's ``MPI_Finalize``
+    static-destructor analogue, csrc/extension.cpp:1313-1321).  No-op if
+    not initialized."""
+    if _STATE["info"] is None:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _STATE["info"] = None
+
+
+def is_distributed() -> bool:
+    """True between :func:`init_distributed` and
+    :func:`finalize_distributed`."""
+    return _STATE["info"] is not None
+
+
+def distributed_info() -> Optional[DistributedInfo]:
+    """The established layout, or None outside a distributed run."""
+    return _STATE["info"]
+
+
+def local_values(stacked):
+    """This process's rows of a ``run_spmd`` output.
+
+    ``run_spmd`` outputs carry a leading per-rank axis laid out over the
+    global mesh; under multi-process each process can only read its own
+    shards (``numpy.asarray`` of the full array raises).  Returns an
+    ndarray of the addressable rows in ascending global-rank order, with
+    their global rank indices::
+
+        ranks, vals = local_values(out)   # vals[i] is rank ranks[i]'s row
+    """
+    import jax
+
+    if isinstance(stacked, np.ndarray):            # already host-local
+        return np.arange(stacked.shape[0]), stacked
+    if not isinstance(stacked, jax.Array):
+        raise TypeError(
+            f"local_values expects a run_spmd output (jax.Array or "
+            f"ndarray); got {type(stacked).__name__} — apply it per leaf "
+            "for pytree outputs")
+    shards = sorted(stacked.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    ranks = []
+    rows = []
+    for s in shards:
+        sl = s.index[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else stacked.shape[0]
+        ranks.extend(range(start, stop))
+        rows.append(np.asarray(s.data))
+    return np.asarray(ranks), np.concatenate(rows, axis=0)
