@@ -1,0 +1,20 @@
+# Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
+# reference-parity build) and falls back to the stdlib-only generator so
+# HTML docs build in any environment.
+.PHONY: docs test native clean-docs
+
+docs:
+	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
+		sphinx-build -b html doc doc/html; \
+	else \
+		python doc/build_docs.py; \
+	fi
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C mpi4torch_tpu/_native
+
+clean-docs:
+	rm -rf doc/html
