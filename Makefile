@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test native clean-docs
+.PHONY: docs test tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -12,6 +12,14 @@ docs:
 
 test:
 	python -m pytest tests/ -q
+
+# Hardware-gated subset: requires a real TPU.  The escape hatch opens the
+# conftest platform gate (which otherwise pins cpu, regardless of any
+# ambient JAX_PLATFORMS a TPU plugin's environment may set) so the
+# compiled, non-interpret Pallas kernel tests EXECUTE rather than skip.
+tpu-test:
+	MPI4TORCH_TPU_REAL_DEVICES=1 python -m pytest tests/test_flash.py -q -rs \
+		-k "Compiled or Pallas or LanePadding"
 
 native:
 	$(MAKE) -C mpi4torch_tpu/_native

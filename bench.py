@@ -1,12 +1,13 @@
 """Headline benchmark: Allreduce fwd+bwd bandwidth + single-chip MFU.
 
-Three measurements, all jitted XLA programs, printed as ONE JSON line:
+Three measurements, all jitted XLA programs, printed as ONE JSON line on
+stdout (progress/partial lines go to stderr):
 
 1. **Allreduce forward+backward effective bandwidth** (the BASELINE.md
    primary metric).  On N>1 devices this uses ring-allreduce
    bytes-on-wire accounting ``2*(N-1)/N * size``; on a single chip there
    is no interconnect, so the number is the HBM-limited throughput of
-   the same program (honestly labeled).
+   the same program (honestly labeled, with the roofline fraction).
 2. **Flash-attention fwd+bwd MFU** — the Pallas kernel
    (mpi4torch_tpu/ops/flash.py) on a chip-sized causal shape; achieved
    FLOP/s vs the chip's peak.  Chip-meaningful even on one device.
@@ -16,11 +17,28 @@ Three measurements, all jitted XLA programs, printed as ONE JSON line:
    ``6 * n_params * n_tokens`` dense-FLOPs accounting plus the causal
    attention term.
 
-Robustness contract (round-1 postmortem): the externally-registered TPU
-plugin (axon) can *hang* or *error* at backend init.  The TPU backend is
-therefore probed in a subprocess with a timeout; on any failure the
-bench pins the CPU platform and still emits a labeled JSON line with
-``"tpu_unavailable": true`` — never a non-zero exit.
+Robustness contract (round-1 + round-3 postmortems):
+- the externally-registered TPU plugin (axon) can *hang* or *error* at
+  backend init, so the TPU backend is probed in a subprocess with a
+  timeout; on failure the bench pins the CPU platform and emits a
+  labeled JSON with ``"tpu_unavailable": true``;
+- EVERY sub-bench runs inside its own try/except: a crash records a
+  ``{"error": ...}`` stanza for that sub-bench and the bench continues
+  (round 3 lost its only on-chip Allreduce number to a later sub-bench's
+  compile failure — a completed measurement must never be erased by a
+  subsequent crash);
+- partial results are flushed to stderr as they land, the final JSON is
+  printed in a ``finally:``, and the process always exits 0.
+
+Timing methodology (round-3 postmortem): each timed iteration calls
+``block_until_ready`` on its own output.  Timing N async dispatches and
+blocking only once at the end measured 23 TB/s "bandwidth" on a chip
+whose HBM peaks at 0.82 TB/s — under the remote-tunnel runtime,
+waiting on the last of N independent executions does not imply the
+other N-1 completed.  Per-iteration blocking adds ~tens of µs of
+dispatch latency to steps that take hundreds of µs; the reported number
+must be HBM-roofline-plausible, and the JSON carries the roofline
+fraction so the sanity check is visible.
 
 Baseline: the reference publishes no numbers (BASELINE.md); the working
 target for the headline metric is 80% of ~45 GB/s/link v5e ICI
@@ -34,28 +52,32 @@ import os
 import subprocess
 import sys
 import time
+import traceback
 
-# Known per-chip bf16 peak FLOP/s by PJRT device_kind substring.  The
-# fallback (v5e) is the BASELINE.md reference hardware.
-_PEAK_FLOPS = [
-    ("v6", 918e12),       # Trillium
-    ("v5p", 459e12),
-    ("v5", 197e12),       # v5e / "TPU v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
+# Known per-chip bf16 peak FLOP/s and HBM bandwidth (bytes/s) by PJRT
+# device_kind substring.  The fallback (v5e) is the BASELINE.md reference
+# hardware.
+_CHIP_TABLE = [
+    # (substring, peak bf16 FLOP/s, HBM GB/s)
+    ("v6", 918e12, 1640.0),   # Trillium
+    ("v5p", 459e12, 2765.0),
+    ("v5", 197e12, 819.0),    # v5e / "TPU v5 lite"
+    ("v4", 275e12, 1228.0),
+    ("v3", 123e12, 900.0),
 ]
 _DEFAULT_PEAK = 197e12
+_DEFAULT_HBM = 819.0
 
 
-def _peak_flops(device_kind: str) -> float:
+def _chip_specs(device_kind: str):
     kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
+    for sub, peak, hbm in _CHIP_TABLE:
         if sub in kind:
-            return peak
-    return _DEFAULT_PEAK
+            return peak, hbm
+    return _DEFAULT_PEAK, _DEFAULT_HBM
 
 
-def _probe_tpu(timeout: float = 120.0):
+def _probe_tpu(timeout: float = 180.0):
     """Initialize the TPU backend in a THROWAWAY subprocess.
 
     Returns ``(device_kind, n_devices)`` if a TPU came up, else None.
@@ -82,18 +104,27 @@ def _probe_tpu(timeout: float = 120.0):
 
 
 def _timeit(fn, *args, iters: int):
+    """Median seconds/step with PER-ITERATION completion barriers (see the
+    module docstring — end-of-loop blocking under-measured by 20x on the
+    tunnel runtime)."""
     import jax
 
-    out = fn(*args)              # compile + warmup
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))     # compile + warmup
+    jax.block_until_ready(fn(*args))
+    times = []
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
 
 
-def _bench_allreduce(on_tpu: bool):
+def _note(msg: str) -> None:
+    print(f"bench.py: {msg}", file=sys.stderr, flush=True)
+
+
+def _bench_allreduce(on_tpu: bool, hbm_gbps: float):
     import jax
     import jax.numpy as jnp
 
@@ -120,7 +151,20 @@ def _bench_allreduce(on_tpu: bool):
     else:
         wire = float(bytes_per_pass)
     gbps = 2.0 * wire / dt / 1e9       # fwd psum + adjoint psum per step
-    return gbps, n, bytes_per_pass, dt
+    # Single chip: the same accounting (2 x tensor bytes / step) is the
+    # program's minimum HBM traffic (read x + write grad), so gbps/HBM-peak
+    # is a true roofline fraction — >1.0 would mean the measurement is
+    # broken, which is exactly what round 3 shipped.
+    roofline = gbps / hbm_gbps if n == 1 else None
+    return {
+        "gbps": round(gbps, 3),
+        "n_devices": n,
+        "tensor_mib": bytes_per_pass / (1 << 20),
+        "seconds_per_step": dt,
+        "hbm_roofline_fraction": (round(roofline, 4)
+                                  if roofline is not None else None),
+        "suspect": bool(roofline is not None and roofline > 1.0),
+    }
 
 
 def _bench_flash(on_tpu: bool, peak: float):
@@ -152,15 +196,25 @@ def _bench_flash(on_tpu: bool, peak: float):
     fwd = 2.0 * b * h * s * s * d
     flops = 3.0 * fwd
     achieved = flops / dt
-    kernel_engaged = bool(
-        on_tpu and flash._eligible(q, k))
+    # The timed step is fwd+bwd: report each kernel's engagement — the
+    # backward is ~2/3 of the FLOPs and gates independently (its own
+    # eligibility + compile probe), so a single flag would mislabel a
+    # jnp-backward run as fully fused.
+    fwd_kernel = bool(
+        on_tpu and flash._eligible(q, k)
+        and flash._pallas_compiles(s, s, d, dtype, True))
+    bwd_kernel = bool(
+        on_tpu and flash._bwd_eligible(q, k)
+        and flash._pallas_bwd_compiles(s, s, d, dtype, True))
     return {
         "tflops": round(achieved / 1e12, 3),
         "mfu": round(achieved / peak, 4),
         "shape": [b, s, h, d],
         "dtype": str(jnp.dtype(dtype)),
         "seconds_per_step": dt,
-        "pallas_kernel": kernel_engaged,
+        "pallas_kernel": fwd_kernel and bwd_kernel,
+        "pallas_fwd": fwd_kernel,
+        "pallas_bwd": bwd_kernel,
     }
 
 
@@ -213,54 +267,88 @@ def _bench_train_step(on_tpu: bool, peak: float):
     }
 
 
-def main() -> None:
-    cpu_pinned = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
-    tpu_info = None if cpu_pinned else _probe_tpu()
-    # tpu_unavailable marks a FAILED probe only; a deliberate
-    # JAX_PLATFORMS=cpu smoke run reports cpu_requested instead.
-    tpu_unavailable = not cpu_pinned and tpu_info is None
+def _guarded(name: str, fn, *args):
+    """Run one sub-bench; on ANY failure return an error stanza instead of
+    propagating (a completed earlier measurement must survive a later
+    crash — round-3 postmortem)."""
+    try:
+        res = fn(*args)
+        _note(f"{name}: {json.dumps(res)}")
+        return res
+    except BaseException as e:  # noqa: BLE001 — even SystemExit must not kill the bench
+        tail = traceback.format_exc().strip().splitlines()[-6:]
+        _note(f"{name} FAILED: {e!r}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}",
+                "traceback_tail": tail}
 
-    if tpu_info is None:
-        # Either the user pinned CPU or the TPU probe failed/timed out.
-        # The env var alone does not stop an externally-registered TPU
-        # plugin from initializing (and hanging); the config update does.
+
+def main() -> None:
+    result = {
+        "metric": "allreduce_fwd_bwd_bandwidth_per_chip",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        cpu_pinned = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        tpu_info = None if cpu_pinned else _probe_tpu()
+        # tpu_unavailable marks a FAILED probe only; a deliberate
+        # JAX_PLATFORMS=cpu smoke run reports cpu_requested instead.
+        tpu_unavailable = not cpu_pinned and tpu_info is None
+
+        if tpu_info is None:
+            # Either the user pinned CPU or the TPU probe failed/timed
+            # out.  The env var alone does not stop an externally-
+            # registered TPU plugin from initializing (and hanging); the
+            # config update does.
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            device_kind, on_tpu = "cpu", False
+            peak, hbm = _DEFAULT_PEAK, _DEFAULT_HBM
+        else:
+            device_kind, _n = tpu_info
+            on_tpu = True
+            peak, hbm = _chip_specs(device_kind)
+
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        device_kind, on_tpu = "cpu", False
-        peak = _DEFAULT_PEAK
-    else:
-        device_kind, _n = tpu_info
-        on_tpu = True
-        peak = _peak_flops(device_kind)
+        platform = jax.devices()[0].platform
+        _note(f"platform={platform} device_kind={device_kind}")
 
-    import jax
+        ar = _guarded("allreduce", _bench_allreduce, on_tpu, hbm)
+        flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
+        train_res = _guarded("train_step", _bench_train_step, on_tpu, peak)
 
-    platform = jax.devices()[0].platform
-    gbps, n, bytes_per_pass, dt = _bench_allreduce(on_tpu)
-    flash_res = _bench_flash(on_tpu, peak)
-    train_res = _bench_train_step(on_tpu, peak)
-
-    target_gbps = 36.0  # 0.8 * ~45 GB/s v5e ICI per-link (BASELINE.md)
-    print(json.dumps({
-        "metric": "allreduce_fwd_bwd_bandwidth_per_chip",
-        "value": round(gbps, 3),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / target_gbps, 4),
-        "n_devices": n,
-        "platform": platform,
-        "device_kind": device_kind,
-        "tpu_unavailable": tpu_unavailable,
-        "cpu_requested": cpu_pinned,
-        "tensor_mib": bytes_per_pass / (1 << 20),
-        "seconds_per_step": dt,
-        "peak_flops_assumed": peak,
-        "flash_attention_fwd_bwd": flash_res,
-        "train_step": train_res,
-        "note": ("ring-allreduce bytes-on-wire accounting" if n > 1 else
-                 "single chip: HBM-limited pipeline throughput, no ICI; "
-                 "MFU sub-benches are the chip-meaningful numbers"),
-    }))
+        target_gbps = 36.0  # 0.8 * ~45 GB/s v5e ICI per-link (BASELINE.md)
+        gbps = float(ar.get("gbps", 0.0)) if "error" not in ar else 0.0
+        result.update({
+            "value": round(gbps, 3),
+            "vs_baseline": round(gbps / target_gbps, 4),
+            "n_devices": ar.get("n_devices"),
+            "platform": platform,
+            "device_kind": device_kind,
+            "tpu_unavailable": tpu_unavailable,
+            "cpu_requested": cpu_pinned,
+            "allreduce": ar,
+            "peak_flops_assumed": peak,
+            "hbm_gbps_assumed": hbm,
+            "flash_attention_fwd_bwd": flash_res,
+            "train_step": train_res,
+            "note": ("ring-allreduce bytes-on-wire accounting"
+                     if (ar.get("n_devices") or 1) > 1 else
+                     "single chip: HBM-limited pipeline throughput, no "
+                     "ICI; MFU sub-benches are the chip-meaningful "
+                     "numbers"),
+        })
+    except BaseException as e:  # noqa: BLE001
+        result["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        result["traceback_tail"] = (
+            traceback.format_exc().strip().splitlines()[-6:])
+    finally:
+        print(json.dumps(result), flush=True)
+        # Robustness contract: never a non-zero exit.
+        os._exit(0)
 
 
 if __name__ == "__main__":
