@@ -32,7 +32,8 @@ from .tp import (
     tp_mlp,
 )
 from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
-from .pp import pipeline_spmd, pipeline_step, recv_activation, send_activation
+from .pp import (pipeline_spmd, pipeline_step, pipeline_step_1f1b,
+                 recv_activation, schedule_1f1b, send_activation)
 
 __all__ = [
     "attention",
@@ -58,6 +59,8 @@ __all__ = [
     "top1_route",
     "pipeline_spmd",
     "pipeline_step",
+    "pipeline_step_1f1b",
+    "schedule_1f1b",
     "recv_activation",
     "send_activation",
 ]

@@ -122,7 +122,7 @@ def pipeline_step(comm, apply_stage: Callable[[Any, Any], Any], params,
 
 def pipeline_spmd(comm, apply_stage: Callable[[Any, Any], Any],
                   stage_params, microbatches: List,
-                  loss_fn: Callable[[Any, int], Any]):
+                  loss_fn: Callable[[Any, Any], Any]):
     """Single-trace GPipe for the SPMD mesh backend: returns the total
     pipeline loss, identical on every rank.
 
@@ -131,30 +131,154 @@ def pipeline_spmd(comm, apply_stage: Callable[[Any, Any], Any],
     becomes array masking): every rank holds its stage's params
     (``stage_params``, already sliced — e.g. ``shard_axis`` of a stacked
     ``(size, ...)`` tree), activations advance one hop per step over the
-    differentiable ring (``ppermute`` on ICI), rank 0 injects microbatches,
-    and the last rank's masked contributions accumulate into the loss.
-    ``n_mb + size - 1`` steps total; each step's compute is live on the
-    ranks inside the fill-drain window and masked elsewhere.  Gradients
-    need no token plumbing: the ring transport's adjoint is the reverse
-    ring, generated by ``jax.grad`` of the returned loss."""
+    differentiable ring (one ``collective_permute`` on ICI per step — the
+    only wire traffic), rank 0 injects microbatches, and the last rank's
+    masked contributions accumulate into the loss.
+
+    The ``n_mb + size - 1`` steps run under ``lax.scan``, so the compiled
+    program is O(1) in both microbatch count and pipeline depth (one stage
+    compute + one collective_permute in the scan body — HLO-censused,
+    tests/test_pp.py), and long pipelines do not blow up trace/compile
+    time the way an unrolled loop does.  Per step each rank computes its
+    stage exactly once; ranks outside the fill/drain window compute into
+    masked lanes — the (n_mb + size - 1)/n_mb bubble inherent to any
+    uniform-program GPipe, not a ``size``-proportional redundancy.
+
+    ``loss_fn(y, i)`` receives the microbatch index as a *traced* i32
+    scalar (scan-carried), so it must treat ``i`` arithmetically
+    (weighting, ``dynamic_slice`` target lookup) rather than as a Python
+    list index.  Gradients need no token plumbing: the ring transport's
+    adjoint is the reverse ring, generated by ``jax.grad`` of the
+    returned loss (XLA transposes the scan)."""
     from .ring import ring_shift
     from ..constants import MPI_SUM
 
     size = comm.size
     n_mb = len(microbatches)
     rank = jnp.asarray(comm.rank)
-    x = jnp.zeros_like(microbatches[0])
-    total = jnp.zeros(())
-    for step in range(n_mb + size - 1):
-        if step < n_mb:
-            x = jnp.where(rank == 0, microbatches[step], x)
+    mbs = jnp.stack(microbatches)                       # (n_mb, ...)
+    n_steps = n_mb + size - 1
+
+    def body(carry, step):
+        x, total = carry
+        inject = jax.lax.dynamic_index_in_dim(
+            mbs, jnp.minimum(step, n_mb - 1), 0, keepdims=False)
+        x = jnp.where((rank == 0) & (step < n_mb), inject, x)
         y = apply_stage(stage_params, x)
         mb_idx = step - (size - 1)
-        if 0 <= mb_idx < n_mb:
-            total = total + jnp.where(rank == size - 1,
-                                      loss_fn(y, mb_idx), 0.0)
-        if step + 1 < n_mb + size - 1:
-            x = ring_shift(comm, y, 1, tag=step)
+        live = (rank == size - 1) & (mb_idx >= 0)
+        total = total + jnp.where(
+            live, loss_fn(y, jnp.maximum(mb_idx, 0)), 0.0)
+        # The final step's shift carries no live data (every microbatch
+        # has reached the last stage) but keeps the scan body uniform —
+        # one ppermute per step, schedule-independent of n_mb/size.
+        x = ring_shift(comm, y, 1, tag=0)
+        return (x, total), None
+
+    x0 = jnp.zeros_like(microbatches[0])
+    (x, total), _ = jax.lax.scan(
+        body, (x0, jnp.zeros(())), jnp.arange(n_steps, dtype=jnp.int32))
     if size > 1:
         total = comm.Allreduce(total, MPI_SUM)
     return total
+
+
+def schedule_1f1b(rank: int, size: int, n_mb: int):
+    """The 1F1B order for one stage: ``[("F", i) | ("B", i)]``.
+
+    ``size - 1 - rank`` warmup forwards, then steady-state one-forward/
+    one-backward pairs, then the backward drain.  At most
+    ``min(size - rank, n_mb)`` microbatches are ever awaiting backward on
+    this stage — the 1F1B memory bound (vs. GPipe's ``n_mb``); asserted
+    in tests/test_pp.py."""
+    warmup = min(size - 1 - rank, n_mb)
+    ops = [("F", i) for i in range(warmup)]
+    for j in range(n_mb - warmup):
+        ops.append(("F", warmup + j))
+        ops.append(("B", j))
+    for j in range(max(n_mb - warmup, 0), n_mb):
+        ops.append(("B", j))
+    return ops
+
+
+def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
+                       microbatches: List,
+                       loss_fn: Callable[[Any, int], Any],
+                       recv_like=None, tag: int = 0):
+    """One training step of a 1F1B (PipeDream-flush) pipeline; returns
+    ``(loss, grads)`` on every rank.
+
+    Same contract as :func:`pipeline_step` (stage ``r`` = rank ``r``,
+    ``recv_like`` required on ranks > 0), but the schedule interleaves
+    each microbatch's backward as soon as its downstream cotangent can
+    exist, so at most ``size - rank`` activation stashes are live per
+    stage instead of GPipe's ``n_mb`` — the schedule that makes deep
+    pipelines trainable at large microbatch counts.
+
+    Implementation note: 1F1B *requires* alternating forward and backward
+    work within one rank's program, which no single ``jax.value_and_grad``
+    call can express — so this scheduler drives per-microbatch
+    ``jax.vjp`` pullbacks explicitly and moves activations/cotangents with
+    plain (non-differentiated) ``Send``/``Recv``.  The AD-transparent
+    formulation (communication *inside* the differentiated graph, adjoint
+    sends auto-generated — the reference's signature capability,
+    csrc/extension.cpp:1048-1265) is :func:`pipeline_step`; this is the
+    hand-scheduled counterpart built on the same p2p substrate, with
+    cotangent messages on their own tag range (the moral analogue of the
+    reference's tag+10 reverse-flow discipline,
+    csrc/extension.cpp:1159-1166).  Deadlock-free because sends are
+    buffered (ops/eager.py Isend: payload is deposited immediately;
+    Wait-on-send is local)."""
+    rank, size = int(comm.rank), comm.size
+    n_mb = len(microbatches)
+    if size == 1:
+        # Identical contract at size 1: defer to the GPipe solo path.
+        return pipeline_step(comm, apply_stage, params, microbatches,
+                             loss_fn, tag=tag)
+    if rank > 0 and recv_like is None:
+        raise ValueError("ranks > 0 need recv_like (incoming activation "
+                         "shape/dtype)")
+    fwd_tag = tag            # + i, activation of microbatch i
+    bwd_tag = tag + n_mb     # + i, cotangent of microbatch i
+    is_last = rank == size - 1
+
+    import collections
+
+    stash = collections.deque()   # (pullback, out_aval) per in-flight mb
+    grads = jax.tree.map(jnp.zeros_like, params)
+    total = jnp.zeros(())
+
+    def fwd(i):
+        nonlocal total
+        if rank == 0:
+            x = microbatches[i]
+        else:
+            x = comm.Recv(jnp.zeros_like(recv_like), rank - 1, fwd_tag + i)
+        if is_last:
+            li, pull = jax.vjp(
+                lambda p, x: loss_fn(apply_stage(p, x), i), params, x)
+            total = total + li
+            stash.append((pull, None))
+        else:
+            y, pull = jax.vjp(apply_stage, params, x)
+            comm.Send(y, rank + 1, fwd_tag + i)
+            stash.append((pull, jax.eval_shape(lambda: y)))
+
+    def bwd(i):
+        nonlocal grads
+        pull, out_aval = stash.popleft()
+        if is_last:
+            ct = jnp.ones(())
+        else:
+            ct = comm.Recv(jnp.zeros(out_aval.shape, out_aval.dtype),
+                           rank + 1, bwd_tag + i)
+        dp, dx = pull(ct)
+        grads = jax.tree.map(jnp.add, grads, dp)
+        if rank > 0:
+            comm.Send(dx, rank - 1, bwd_tag + i)
+
+    for op, i in schedule_1f1b(rank, size, n_mb):
+        (fwd if op == "F" else bwd)(i)
+
+    loss = comm.Bcast_(total, size - 1)
+    return loss, grads

@@ -27,6 +27,7 @@ immediately (the reference's guards: csrc/extension.cpp:395-403, 1196-1202,
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -102,10 +103,16 @@ class World:
     a signature consistency check.
     """
 
-    def __init__(self, size: int, timeout: float = 60.0):
+    def __init__(self, size: int, timeout: Optional[float] = None):
         if size < 1:
             raise ValueError("World size must be >= 1")
         self.size = size
+        if timeout is None:
+            # Deadlock-detection wall clock, not a performance knob: big
+            # models on slow hosts can exceed any fixed default, so CI
+            # and heavyweight runs may override via the environment.
+            timeout = float(os.environ.get(
+                "MPI4TORCH_TPU_WORLD_TIMEOUT", "60"))
         self.timeout = timeout
         self._barrier = threading.Barrier(size)
         self._slots: List[Any] = [None] * size
