@@ -127,6 +127,18 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
 # ---------------------------------------------------------------------------
 
 
+def _causal_n_live(qoff, kvoff, qi, qt: int, kv_tile: int, n_tiles: int):
+    """Number of leading KV tiles that can contain unmasked positions for
+    q tile ``qi``: tiles whose first position <= this q tile's LAST
+    position (q_hi).  Skipped tiles are exactly neutral in both the
+    online-softmax carry and the gradients (p is where-masked to zero),
+    so cutting the loop at the diagonal halves causal work without
+    changing any output bit.  Traced-scalar offsets (rank-symbolic under
+    SPMD) are fine: the bound feeds a dynamic fori_loop."""
+    q_hi = qoff + (qi + 1) * qt - 1
+    return jnp.clip((q_hi - kvoff) // kv_tile + 1, 0, n_tiles)
+
+
 def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 *, causal: bool, kv_tile: int, true_d: int):
     from jax.experimental import pallas as pl
@@ -140,18 +152,22 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # true head_dim (padded columns are zero and change no dot product).
     scale = 1.0 / jnp.sqrt(jnp.asarray(true_d, f32))
 
-    qb = q_ref[0].astype(f32) * scale                       # (QT, D)
+    # Operands stay in their input dtype for the MXU dots (bf16 inputs
+    # run at the MXU's bf16 rate; an up-front astype(f32) would force
+    # f32-rate multiplies) — accumulation is f32 via
+    # preferred_element_type, and the scale is applied to the f32 scores.
+    qb = q_ref[0]                                           # (QT, D)
     qi = pl.program_id(1)
     q_pos = (qoff_ref[0, 0] + qi * qt
              + jax.lax.broadcasted_iota(i32, (qt, 1), 0))    # (QT, 1)
 
     def body(j, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * kv_tile, kv_tile), :].astype(f32)
-        vb = v_ref[0, pl.ds(j * kv_tile, kv_tile), :].astype(f32)
+        kb = k_ref[0, pl.ds(j * kv_tile, kv_tile), :]
+        vb = v_ref[0, pl.ds(j * kv_tile, kv_tile), :]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=f32)                      # (QT, KT)
+            preferred_element_type=f32) * scale              # (QT, KT)
         if causal:
             kv_pos = (kvoff_ref[0, 0] + j * kv_tile
                       + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
@@ -164,14 +180,16 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * corr + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=f32)
         return m_new, l, acc
 
     m0 = jnp.full((qt, 1), NEG_BIG, f32)
     l0 = jnp.zeros((qt, 1), f32)
     acc0 = jnp.zeros((qt, d), f32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    n_live = (_causal_n_live(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
+                             kv_tile, n_kv) if causal else n_kv)
+    m, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
 
     nonzero = l > 0
     safe_l = jnp.where(nonzero, l, 1.0)
@@ -285,6 +303,7 @@ def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
     lse = NEG_BIG, making the raw exp() garbage; the mask ``where``
     zeroes those entries (same order of operations as the jnp oracle)."""
     f32 = jnp.float32
+    # Native-dtype MXU operands, f32 accumulation (see _fwd_kernel).
     s = jax.lax.dot_general(q_t, k_t, (((1,), (1,)), ((), ())),
                             preferred_element_type=f32) * scale   # (QT, KT)
     p = jnp.exp(s - lse_t)
@@ -307,8 +326,8 @@ def _bwd_dq_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
     sk = k_ref.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(true_d, f32))
 
-    qb = q_ref[0].astype(f32)
-    dob = do_ref[0].astype(f32)
+    qb = q_ref[0]
+    dob = do_ref[0]
     lse_t = _stat_tile(lse_ref[0], kv_tile)
     dd_t = _stat_tile(dd_ref[0], kv_tile)
     qi = pl.program_id(1)
@@ -316,17 +335,20 @@ def _bwd_dq_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
              + jax.lax.broadcasted_iota(i32, (qt, 1), 0))
 
     def body(j, dq):
-        kb = k_ref[0, pl.ds(j * kv_tile, kv_tile), :].astype(f32)
-        vb = v_ref[0, pl.ds(j * kv_tile, kv_tile), :].astype(f32)
+        kb = k_ref[0, pl.ds(j * kv_tile, kv_tile), :]
+        vb = v_ref[0, pl.ds(j * kv_tile, kv_tile), :]
         kv_pos = (kvoff_ref[0, 0] + j * kv_tile
                   + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
         _, ds = _bwd_p_ds(qb, kb, vb, dob, lse_t, dd_t,
                           q_pos, kv_pos, causal, scale)
         return dq + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=f32) * scale
 
-    dq = jax.lax.fori_loop(0, sk // kv_tile, body, jnp.zeros((qt, d), f32))
+    n_live = (_causal_n_live(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
+                             kv_tile, sk // kv_tile)
+              if causal else sk // kv_tile)
+    dq = jax.lax.fori_loop(0, n_live, body, jnp.zeros((qt, d), f32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
@@ -340,17 +362,17 @@ def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
     sq = q_ref.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(true_d, f32))
 
-    kb = k_ref[0].astype(f32)
-    vb = v_ref[0].astype(f32)
+    kb = k_ref[0]
+    vb = v_ref[0]
     ki = pl.program_id(1)
     kv_pos = (kvoff_ref[0, 0] + ki * kt
               + jax.lax.broadcasted_iota(i32, (1, kt), 1))
 
     def body(i, carry):
         dk, dv = carry
-        q_t = q_ref[0, pl.ds(i * q_tile, q_tile), :].astype(f32)
-        do_t = do_ref[0, pl.ds(i * q_tile, q_tile), :].astype(f32)
         qs = pl.ds(i * q_tile, q_tile)
+        q_t = q_ref[0, qs, :]
+        do_t = do_ref[0, qs, :]
         lse_t = _stat_tile(lse_ref[0, qs, :], kt)
         dd_t = _stat_tile(dd_ref[0, qs, :], kt)
         q_pos = (qoff_ref[0, 0] + i * q_tile
@@ -358,15 +380,25 @@ def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
         p, ds = _bwd_p_ds(q_t, kb, vb, do_t, lse_t, dd_t,
                           q_pos, kv_pos, causal, scale)
         dv = dv + jax.lax.dot_general(
-            p, do_t, (((0,), (0,)), ((), ())),
+            p.astype(do_t.dtype), do_t, (((0,), (0,)), ((), ())),
             preferred_element_type=f32)                    # (KT, D)
         dk = dk + jax.lax.dot_general(
-            ds, q_t, (((0,), (0,)), ((), ())),
+            ds.astype(q_t.dtype), q_t, (((0,), (0,)), ((), ())),
             preferred_element_type=f32) * scale
         return dk, dv
 
     dk0 = jnp.zeros((kt, d), f32)
-    dk, dv = jax.lax.fori_loop(0, sq // q_tile, body, (dk0, dk0))
+    if causal:
+        # Mirror cut: q tile i contributes iff its last position reaches
+        # this KV block's first position — start the loop at the
+        # diagonal.  i_min = floor((kv_lo - qoff) / q_tile) (clipped), the
+        # first tile whose max q_pos >= kv_lo.
+        kv_lo = kvoff_ref[0, 0] + ki * kt
+        i_start = jnp.clip((kv_lo - qoff_ref[0, 0]) // q_tile, 0,
+                           sq // q_tile)
+    else:
+        i_start = 0
+    dk, dv = jax.lax.fori_loop(i_start, sq // q_tile, body, (dk0, dk0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

@@ -78,6 +78,15 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
     out = None
     lse = None
     for step in range(size):
+        # Issue the NEXT block's ring hop before this block's compute:
+        # the permute reads the same K/V the compute does (no data
+        # dependence between them), so putting the collective first in
+        # program order lets XLA's async collective-permute-start/done
+        # pair bracket the block matmuls — communication hides behind
+        # compute instead of serializing after it.
+        if step + 1 < size:
+            k_next = ring_shift(comm, k, 1, tag + 2 * step)
+            v_next = ring_shift(comm, v, 1, tag + 2 * step + 1)
         # After `step` +1-shifts the local K/V block originated on rank
         # (my_rank - step) % size.
         owner = (my_rank - step) % size
@@ -88,10 +97,8 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
             out, lse = o_b, lse_b
         else:
             out, lse = merge_partials(out, lse, o_b, lse_b)
-
         if step + 1 < size:
-            k = ring_shift(comm, k, 1, tag + 2 * step)
-            v = ring_shift(comm, v, 1, tag + 2 * step + 1)
+            k, v = k_next, v_next
 
     return out
 
