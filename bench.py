@@ -77,7 +77,7 @@ def _chip_specs(device_kind: str):
     return _DEFAULT_PEAK, _DEFAULT_HBM
 
 
-def _probe_tpu(timeout: float = 180.0):
+def _probe_tpu(timeout: float = 300.0):
     """Initialize the TPU backend in a THROWAWAY subprocess.
 
     Returns ``(device_kind, n_devices)`` if a TPU came up, else None.

@@ -103,14 +103,25 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
     return out
 
 
-def ulysses_attention(comm, q, k, v, causal: bool = False):
+def ulysses_attention(comm, q, k, v, causal: bool = False,
+                      impl: str = "auto"):
     """Ulysses sequence parallelism: all-to-all head<->sequence reshuffle.
 
     Each rank trades its sequence shard of ALL heads for the FULL sequence
     of ``heads/size`` heads (one ``Alltoall`` per tensor — the exact
     exchange the reference's axis-generic Alltoall was built for), runs
-    dense attention on its head group, and reshuffles back.  Requires
-    ``heads % size == 0``."""
+    attention on its head group, and reshuffles back.  Requires
+    ``heads % size == 0``.
+
+    The per-head-group attention is the fused block primitive
+    (:func:`~mpi4torch_tpu.ops.flash.flash_attention`): after the
+    reshuffle each rank sees the FULL sequence, exactly the regime where
+    materializing the (s_global, s_global) score matrix stops being an
+    option — on eligible TPU shapes the Pallas kernel keeps scores in
+    VMEM, elsewhere the jnp path matches dense attention to oracle
+    precision.  ``impl`` forces a path (tests pin both)."""
+    from ..ops.flash import flash_attention
+
     size = comm.size
     b, s_local, h, d = q.shape
     if h % size != 0:
@@ -128,6 +139,6 @@ def ulysses_attention(comm, q, k, v, causal: bool = False):
         return comm.Alltoall(x, gatheraxis=2, scatteraxis=1,
                              numelem=s_local)
 
-    out = dense_attention(to_heads(q), to_heads(k), to_heads(v),
-                          causal=causal)
+    out = flash_attention(to_heads(q), to_heads(k), to_heads(v),
+                          causal=causal, impl=impl)
     return to_seq(out)

@@ -215,3 +215,22 @@ class TestTreeBcastExecution:
             for r in range(nr):
                 np.testing.assert_array_equal(
                     out[r], np.arange(8.0) + 100.0 * (nr - 1))
+
+
+class TestStrategyCensus:
+    """Wire counts of the composed strategies: the ring-attention loop
+    must ship exactly 2*(size-1) hops (K and V per non-final step) — the
+    comm/compute overlap reordering must not duplicate or drop any."""
+
+    def test_ring_attention_wire_count(self):
+        from mpi4torch_tpu.parallel import ring_attention
+
+        q = jnp.ones((1, 8 * NR, 2, 8))
+
+        def fn(comm, q):
+            r = jnp.asarray(comm.rank)
+            sl = jax.lax.dynamic_slice_in_dim(q, r * 8, 8, 1)
+            return ring_attention(comm, sl, sl, sl, causal=True)
+
+        got = census(fn, q)
+        assert got == only(collective_permute=2 * (NR - 1)), got
