@@ -1,5 +1,7 @@
-"""Utilities: eager optimizers and test helpers."""
+"""Utilities: eager optimizers, checkpoint/resume, test helpers."""
 
+from .checkpoint import CheckpointManager, restore_checkpoint, save_checkpoint
 from .lbfgs import LBFGS, minimize_lbfgs
 
-__all__ = ["LBFGS", "minimize_lbfgs"]
+__all__ = ["LBFGS", "minimize_lbfgs", "CheckpointManager",
+           "restore_checkpoint", "save_checkpoint"]

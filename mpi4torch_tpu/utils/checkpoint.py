@@ -1,0 +1,138 @@
+"""Training-state checkpoint / resume on orbax.
+
+The reference has NO training-state checkpointing — its only persistence
+artifact is the TorchScript communicator pickle, whose semantics this
+framework already fixes (SURVEY.md §5 Checkpoint/resume; comm.py
+world-only pickle + tests/test_pickle.py).  A TPU-native framework's
+training loops still need crash/preemption resume, so this module
+packages the orbax discipline behind two calls and a manager:
+
+* :func:`save_checkpoint` / :func:`restore_checkpoint` — one pytree
+  (params, optimizer state, step counter, RNG key, ...) to/from a
+  directory.  Restore takes the *template* tree (same treedef and leaf
+  shapes/dtypes, e.g. a freshly initialized state), which is also what
+  makes restoration work with sharded ``jax.Array`` leaves: orbax reads
+  each shard to the template's sharding, so a multi-host mesh restores
+  without gathering to one host.
+* :class:`CheckpointManager` — step-numbered checkpoints with retention
+  (``max_to_keep``), ``latest_step()`` discovery, and atomic finalize
+  (a crash mid-save never corrupts the latest complete checkpoint —
+  orbax writes to a temp dir and renames).
+
+Under the multi-process runtime (``init_distributed``), every process
+must call save/restore collectively — orbax coordinates through the same
+JAX distributed client; the ``MPI4TORCH_TPU_*`` world is not involved in
+the file I/O itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
+    """Write pytree ``state`` to directory ``path`` (created; absolute
+    paths required by orbax — relative inputs are resolved here).
+
+    Atomic: a partially-written checkpoint is never visible at ``path``.
+    ``force`` overwrites an existing complete checkpoint."""
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    try:
+        ckptr.save(path, state, force=force)
+        ckptr.wait_until_finished()
+    finally:
+        ckptr.close()
+
+
+def restore_checkpoint(path: str, template: Any) -> Any:
+    """Read the pytree at ``path`` into ``template``'s structure.
+
+    ``template`` supplies treedef, dtypes and (critically) shardings:
+    leaves restore directly to the template leaf's placement, so a state
+    sharded over a mesh round-trips without host gathering.  Raises
+    ``FileNotFoundError`` when ``path`` holds no complete checkpoint."""
+    import jax
+    import orbax.checkpoint as ocp  # noqa: F401 — orbax must be importable
+
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint directory at {path}")
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    ckptr = _checkpointer()
+    try:
+        return ckptr.restore(path, abstract)
+    finally:
+        ckptr.close()
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention — the resume loop::
+
+        mgr = CheckpointManager(workdir, max_to_keep=3)
+        step = mgr.latest_step()
+        state = mgr.restore(step, template=state) if step is not None \\
+            else init_state
+        for step in range(0 if step is None else step + 1, n_steps):
+            state = train_step(state)
+            mgr.save(step, state)
+        mgr.close()
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: Optional[int] = None,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save ``state`` as checkpoint ``step``; returns whether a save
+        happened (the manager skips off-interval steps unless forced)."""
+        import orbax.checkpoint as ocp
+
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+        return bool(saved)
+
+    def restore(self, step: int, template: Any) -> Any:
+        import jax
+        import orbax.checkpoint as ocp
+
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

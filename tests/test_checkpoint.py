@@ -1,0 +1,127 @@
+"""Checkpoint/resume (utils/checkpoint.py, orbax-backed).
+
+The reference has no training-state persistence (SURVEY.md §5) — these
+tests pin the TPU-native framework's addition: pytree roundtrips
+(including sharded jax.Array leaves restoring to their mesh placement),
+the resume loop reproducing an uninterrupted run bit-for-bit, retention,
+and atomicity of the latest-step discovery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint",
+                    reason="checkpoint subsystem needs orbax "
+                           "(pip install mpi4torch_tpu[checkpoint])")
+
+from mpi4torch_tpu.utils import (CheckpointManager, restore_checkpoint,
+                                 save_checkpoint)  # noqa: E402
+
+
+def make_state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {
+            "w": jnp.asarray(rng.standard_normal((4, 4))),
+            "b": jnp.zeros((4,), jnp.float32),
+        },
+        "opt": {"m": jnp.ones((4, 4)), "count": jnp.asarray(3, jnp.int32)},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+def assert_tree_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)), a, b)
+
+
+class TestRoundtrip:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = make_state()
+        save_checkpoint(str(tmp_path / "ck"), state)
+        got = restore_checkpoint(str(tmp_path / "ck"),
+                                 jax.tree.map(jnp.zeros_like, state))
+        assert_tree_equal(got, state)
+
+    def test_restore_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            restore_checkpoint(str(tmp_path / "nope"), make_state())
+
+    def test_dtypes_preserved(self, tmp_path):
+        state = {"f64": jnp.asarray([1.5], jnp.float64),
+                 "i32": jnp.asarray([2], jnp.int32),
+                 "bf16": jnp.asarray([0.5], jnp.bfloat16)}
+        save_checkpoint(str(tmp_path / "ck"), state)
+        got = restore_checkpoint(str(tmp_path / "ck"),
+                                 jax.tree.map(jnp.zeros_like, state))
+        for k in state:
+            assert got[k].dtype == state[k].dtype, k
+        assert_tree_equal(got, state)
+
+    def test_sharded_leaves_restore_to_mesh(self, tmp_path):
+        # A mesh-sharded array round-trips onto its sharding (no host
+        # gather): the template's placement decides the restore layout.
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("x",))
+        sharding = NamedSharding(mesh, P("x"))
+        x = jax.device_put(jnp.arange(16.0).reshape(4, 4), sharding)
+        save_checkpoint(str(tmp_path / "ck"), {"x": x})
+        template = {"x": jax.device_put(jnp.zeros((4, 4)), sharding)}
+        got = restore_checkpoint(str(tmp_path / "ck"), template)
+        assert got["x"].sharding == sharding
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.asarray(x))
+
+
+class TestManagerResume:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        # An interrupted-then-resumed run must be bit-identical to an
+        # uninterrupted one — the whole point of resume.
+        def train_step(state):
+            g = state["params"] * 0.1 + 1.0
+            return {"params": state["params"] - 0.01 * g,
+                    "step": state["step"] + 1}
+
+        init = {"params": jnp.ones((3,)), "step": jnp.asarray(0, jnp.int32)}
+
+        ref = init
+        for _ in range(6):
+            ref = train_step(ref)
+
+        workdir = str(tmp_path / "run")
+        # Phase 1: 3 steps, checkpointing each, then "crash".
+        with CheckpointManager(workdir) as mgr:
+            state = init
+            for step in range(3):
+                state = train_step(state)
+                mgr.save(step, state)
+            mgr.wait_until_finished()
+        # Phase 2: fresh process-equivalent — discover latest and resume.
+        with CheckpointManager(workdir) as mgr:
+            latest = mgr.latest_step()
+            assert latest == 2
+            state = mgr.restore(latest, template=init)
+            for step in range(latest + 1, 6):
+                state = train_step(state)
+                mgr.save(step, state)
+            mgr.wait_until_finished()
+        assert_tree_equal(state, ref)
+
+    def test_retention_keeps_last_n(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "r"), max_to_keep=2) as mgr:
+            s = {"x": jnp.zeros(())}
+            for step in range(5):
+                mgr.save(step, s, force=True)
+            mgr.wait_until_finished()
+            assert mgr.latest_step() == 4
+            assert len(mgr.all_steps()) == 2
+
+    def test_save_interval_skips_off_steps(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "i"),
+                               save_interval_steps=2) as mgr:
+            s = {"x": jnp.zeros(())}
+            saved = [mgr.save(step, s) for step in range(4)]
+            mgr.wait_until_finished()
+        assert saved == [True, False, True, False]
