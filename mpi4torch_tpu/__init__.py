@@ -49,6 +49,7 @@ from .runtime import (
     InPlaceReuseError,
     run_ranks,
 )
+from .mesh import device_mesh, hybrid_mesh
 from .ops.spmd import RankExpr, p2p_scope, run_spmd
 from .distributed import (
     DistributedInfo,
@@ -83,6 +84,8 @@ __all__ = [
     "deactivate_cuda_aware_mpi_support",
     # TPU-native additions
     "comm_from_mesh",
+    "device_mesh",
+    "hybrid_mesh",
     "run_ranks",
     "p2p_scope",
     "run_spmd",

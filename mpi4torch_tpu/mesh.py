@@ -1,0 +1,127 @@
+"""Mesh construction helpers: ICI-topology-aware and hybrid ICI x DCN.
+
+The reference's transport scaling story is "MPI handles it" — one flat
+communicator regardless of how ranks map onto the physical network
+(SURVEY.md §2.6).  On TPU the network is two-tier: chips within a slice
+connect over ICI (torus links, ~45 GB/s/link on v5e), slices connect
+over DCN (data-center network, ~an order of magnitude slower).  Which
+mesh axes cross which tier decides whether a collective rides ICI or
+DCN, so the framework exposes the mapping explicitly:
+
+* :func:`device_mesh` — single-slice (or CPU-harness) mesh with the axis
+  order chosen so the *innermost* (fastest-varying) axes map onto
+  physically adjacent chips — put the heaviest-traffic axis (TP, then
+  SP) last, DP first.
+* :func:`hybrid_mesh` — multi-slice: DCN-crossing axes are declared
+  separately and are laid out as the outermost factors, so only the axes
+  you *say* cross slices produce DCN traffic (the standard layout: DP
+  over DCN, TP/SP over ICI — jax ``mesh_utils.create_hybrid_device_mesh``
+  underneath).
+
+Both return a plain ``jax.sharding.Mesh`` — everything downstream
+(``comm_from_mesh``, ``shard_map``, the §2.5 strategy layer) is
+mesh-source-agnostic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["device_mesh", "hybrid_mesh"]
+
+
+def _check_sizes(shape: Mapping[str, int], n: int, what: str) -> None:
+    total = math.prod(shape.values())
+    if total != n:
+        raise ValueError(
+            f"{what} axis sizes {dict(shape)} multiply to {total}, but "
+            f"{n} devices are available")
+
+
+def device_mesh(axes: Mapping[str, int], *, devices: Optional[Sequence] = None):
+    """A ``Mesh`` over one slice (or the CPU test harness).
+
+    ``axes`` maps axis name -> size, in significance order: the LAST axis
+    varies fastest over the physical device order, so it lands on
+    adjacent chips — put the axis with the heaviest collective traffic
+    (usually TP or SP) last and DP first.  Uses jax's topology-aware
+    device ordering on real TPU slices (``mesh_utils.create_device_mesh``
+    maps the trailing mesh dims onto the ICI torus) and a plain reshape
+    on other platforms."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    _check_sizes(axes, len(devices), "device_mesh")
+    shape = tuple(axes.values())
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    else:
+        arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def hybrid_mesh(ici_axes: Mapping[str, int], dcn_axes: Mapping[str, int],
+                *, devices: Optional[Sequence] = None):
+    """A ``Mesh`` spanning multiple slices/hosts with explicit tier
+    assignment.
+
+    ``dcn_axes`` axes cross the slice boundary (their total size must
+    equal the number of slices/granules); ``ici_axes`` axes stay inside a
+    slice.  The returned mesh carries the DCN axes first (outermost) then
+    the ICI axes, so e.g. ``hybrid_mesh({"tp": 4}, {"dp": 2})`` gives
+    axis names ``("dp", "tp")`` where only ``dp`` collectives touch DCN.
+
+    On a single granule (one slice, or the CPU harness where every
+    device reports process 0), all ``dcn_axes`` sizes must be 1 and the
+    call degrades to :func:`device_mesh` — the same program then runs
+    unchanged on a pod."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    both = {**dcn_axes, **ici_axes}
+    if len(both) != len(dcn_axes) + len(ici_axes):
+        raise ValueError(
+            f"axis names must be disjoint between tiers; got ICI "
+            f"{tuple(ici_axes)} and DCN {tuple(dcn_axes)}")
+    _check_sizes(both, len(devices), "hybrid_mesh")
+
+    # TPU granulates by slice (processes within one slice are still
+    # ICI-connected); every other platform's slow tier is the process
+    # boundary.  Attribute probing is NOT a platform test: CPU devices
+    # also expose slice_index (always 0) under the distributed runtime.
+    by_process = devices[0].platform != "tpu"
+    n_granules = len({d.process_index if by_process
+                      else getattr(d, "slice_index", 0) for d in devices})
+    dcn_total = math.prod(dcn_axes.values())
+    if n_granules == 1:
+        if dcn_total != 1:
+            raise ValueError(
+                f"dcn axes {dict(dcn_axes)} require {dcn_total} "
+                "slices/processes but all devices are in one granule — "
+                "move those factors to ici_axes (single-slice) or launch "
+                "multi-process (init_distributed)")
+        return device_mesh(both, devices=devices)
+    if dcn_total != n_granules:
+        raise ValueError(
+            f"dcn axes {dict(dcn_axes)} multiply to {dcn_total}, but the "
+            f"devices span {n_granules} slices/granules")
+
+    from jax.experimental import mesh_utils
+
+    ici_shape = [1] * len(dcn_axes) + list(ici_axes.values())
+    dcn_shape = list(dcn_axes.values()) + [1] * len(ici_axes)
+    arr = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=list(devices),
+        # Mirror the granule choice above (jax hard-requires slice_index
+        # unless told to granulate by process).
+        process_is_granule=by_process)
+    return Mesh(arr, tuple(both.keys()))

@@ -62,6 +62,12 @@ _WORKER = textwrap.dedent("""
     np.testing.assert_array_equal(yv[0], 3.0)
     np.testing.assert_array_equal(gv[0], float(n))
 
+    # hybrid_mesh with process-granules: 2 single-device CPU processes
+    # form 2 granules; the dp axis is the DCN/process-crossing tier.
+    m = mpi.hybrid_mesh({"tp": 1}, {"dp": 2})
+    assert m.axis_names == ("dp", "tp"), m.axis_names
+    assert m.shape["dp"] == 2 and m.shape["tp"] == 1, m.shape
+
     # mpi4py interop on an already-initialized runtime: a stand-in comm
     # with the matching layout must validate and adopt it.
     class FakeComm:

@@ -234,6 +234,47 @@ class TestLanePadding:
                                        rtol=1e-3, atol=1e-4)
 
 
+class TestCausalTileSkip:
+    """The diagonal-cut loop bounds (_causal_n_live and the dkv i_start)
+    must be exact at UNALIGNED offsets: a bound off by one tile either
+    recomputes masked work (benign) or skips live keys (wrong output).
+    Sweep odd offsets through the forced kernel path vs the jnp oracle —
+    forward, lse, and all three gradients."""
+
+    @pytest.mark.parametrize("q_off,kv_off", [
+        (0, 0), (1, 0), (0, 1), (77, 0), (0, 77), (128, 200), (200, 128),
+        (1000, 999), (999, 1000), (50, 300),
+    ])
+    def test_unaligned_offsets_match_jnp(self, q_off, kv_off):
+        q, k, v = qkv((1, 256, 1, 64), dtype=jnp.float32,
+                      seed=q_off * 7 + kv_off)
+
+        def loss(impl):
+            def f(q, k, v):
+                out, lse = flash.flash_block_attention(
+                    q, k, v, causal=True, q_offset=q_off,
+                    kv_offset=kv_off, impl=impl)
+                safe = jnp.where(lse > flash.NEG_BIG / 2, lse, 0.0)
+                return jnp.sum(out ** 2) + jnp.sum(safe)
+            return f
+
+        op, lp = flash.flash_block_attention(
+            q, k, v, causal=True, q_offset=q_off, kv_offset=kv_off,
+            impl="pallas")
+        oj, lj = flash.flash_block_attention(
+            q, k, v, causal=True, q_offset=q_off, kv_offset=kv_off,
+            impl="jnp")
+        np.testing.assert_allclose(np.asarray(op), np.asarray(oj),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(lj),
+                                   rtol=1e-4, atol=1e-5)
+        gp = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gj = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gj):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+
 class TestIntegerPositions:
     def test_positions_exact_beyond_f32_range(self):
         # Query block at position 2^24 against one key at 2^24 + 1.  The

@@ -234,3 +234,18 @@ class TestStrategyCensus:
 
         got = census(fn, q)
         assert got == only(collective_permute=2 * (NR - 1)), got
+
+    def test_ulysses_wire_count(self):
+        # Ulysses = one all_to_all per q/k/v into head-sharding plus one
+        # back for the output: exactly 4, independent of size.
+        from mpi4torch_tpu.parallel import ulysses_attention
+
+        q = jnp.ones((1, 8 * NR, NR, 8))
+
+        def fn(comm, q):
+            r = jnp.asarray(comm.rank)
+            sl = jax.lax.dynamic_slice_in_dim(q, r * 8, 8, 1)
+            return ulysses_attention(comm, sl, sl, sl, causal=True)
+
+        got = census(fn, q)
+        assert got == only(all_to_all=4), got
