@@ -310,6 +310,9 @@ def _mask_to_root(ctx: SpmdContext, x, root: int):
 # Crossover at ICI-like alpha/bw sits near a few hundred KiB; 256 KiB is
 # the conservative static switch (shapes are static under jit, so the
 # choice is per-callsite and compiles to exactly one strategy).
+# bench_tradeoffs.py sweeps both lowerings head-to-head across the
+# threshold on whatever hardware is attached — re-run it on a real chip
+# to recalibrate this constant.
 _BCAST_TREE_MAX_BYTES = 256 * 1024
 
 
@@ -426,6 +429,8 @@ def gather(ctx: SpmdContext, x, gatheraxis: int, root: int):
     relay to the root serializes N-1 hops; under SPMD's static shapes the
     all-gather (then mask) is the efficient compiled form — and the root,
     the rank that matters, receives exactly its optimal S*(N-1)/N.
+    bench_tradeoffs.py times Gather vs plain Allgather to quantify the
+    masking overhead on the attached hardware.
     """
     _check_root(ctx, root)
     ax = _norm_axis(gatheraxis, jnp.ndim(x))

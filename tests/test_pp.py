@@ -217,6 +217,50 @@ class Test1F1B:
                 peak = max(peak, live)
             assert peak <= min(size - rank, n_mb), (rank, peak)
 
+    def test_shape_changing_stages(self):
+        # Stages that change the activation width: the backward cotangent
+        # for each rank is shaped like ITS OWN output (stashed out_aval),
+        # not like recv_like — a widening/narrowing pipeline catches any
+        # mix-up.  Widths: 6 -> 10 -> 4.
+        from mpi4torch_tpu.parallel import pipeline_step_1f1b
+
+        widths = [6, 10, 4]
+        rng = np.random.default_rng(3)
+        stages = [{"w": jnp.asarray(
+            rng.standard_normal((widths[i], widths[i + 1]))
+            / np.sqrt(widths[i]))} for i in range(2)]
+        mbs = [jnp.asarray(rng.standard_normal((B, widths[0])))
+               for _ in range(4)]
+
+        def apply(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def total(stages):
+            s = 0.0
+            for i, mb in enumerate(mbs):
+                x = mb
+                for p in stages:
+                    x = apply(p, x)
+                s = s + loss_fn(x, i)
+            return s
+
+        val_d = np.asarray(total(stages))
+        g_d = jax.grad(total)(stages)
+
+        def body():
+            r = int(comm.rank)
+            loss, g = pipeline_step_1f1b(
+                comm, apply, stages[r], mbs, loss_fn,
+                recv_like=jnp.zeros((B, widths[r])))
+            return np.asarray(loss), jax.tree.map(np.asarray, g)
+
+        outs = mpi.run_ranks(body, 2)
+        for r in range(2):
+            loss, g = outs[r]
+            np.testing.assert_allclose(loss, val_d, rtol=1e-12)
+            np.testing.assert_allclose(g["w"], np.asarray(g_d[r]["w"]),
+                                       rtol=1e-9, atol=1e-12)
+
     def test_size_one_is_sequential(self):
         from mpi4torch_tpu.parallel import pipeline_step_1f1b
 
