@@ -741,9 +741,84 @@ def merge_partials(out_a, lse_a, out_b, lse_b):
     return out.astype(out_a.dtype), lse
 
 
-def flash_attention(q, k, v, *, causal: bool = False, impl: str = "auto"):
+def _kv_chunk_for(q, k) -> int:
+    """Largest KV-chunk length that (a) divides the sequence, (b) is a
+    whole number of KV tiles, and (c) fits the kernel's VMEM staging
+    budget — or 0 when chunking cannot make the shape eligible (head dim
+    too small, non-tile-divisible lengths; the caller then falls back to
+    one unchunked call and its usual dispatch).  Pure integer arithmetic:
+    shapes are static, so this runs once per trace.
+
+    Backward eligibility is deliberately NOT required: an ineligible
+    backward falls back per block to the KV-tiled jnp recompute, whose
+    transient slab is (b, sq, h, 128) — chunking still removes the
+    quadratic forward memory either way."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = min(_Q_TILE, sq)
+    if d < 64 or sq % qt != 0 or sk % _KV_TILE != 0:
+        return 0
+    per_token = 2 * _lane_pad(d) * jnp.dtype(k.dtype).itemsize
+    chunk = min((_KV_VMEM_BUDGET // per_token) // _KV_TILE * _KV_TILE, sk)
+    while chunk >= _KV_TILE and sk % chunk != 0:
+        chunk -= _KV_TILE
+    return chunk if chunk >= _KV_TILE else 0
+
+
+def flash_attention(q, k, v, *, causal: bool = False, impl: str = "auto",
+                    kv_chunk: int = 0):
     """Single-device fused attention over the full local KV (the
     non-distributed entry; ``parallel.ring_attention`` composes the block
-    primitive over a mesh axis instead)."""
-    out, _ = flash_block_attention(q, k, v, causal=causal, impl=impl)
+    primitive over a mesh axis instead).
+
+    Long-KV path: the block kernel stages its whole KV block in VMEM, so
+    one call caps the sequence at the VMEM budget (8K tokens at
+    d=128/f32, 16K at bf16).  Beyond that — e.g. the full global sequence each rank
+    sees after the Ulysses reshuffle — the KV is processed in
+    budget-sized chunks under ``lax.scan``, each through the fused
+    kernel, merged by the exact online-softmax rule (the same
+    ``merge_partials`` ring attention uses), so memory stays
+    O(seq + chunks x q) instead of the jnp fallback's quadratic score
+    matrix.  ``kv_chunk`` forces a chunk length (must divide the KV
+    length and be a multiple of the 128 KV tile); 0 picks the largest
+    eligible chunk automatically, and shapes with no eligible chunk take
+    the ordinary single-call dispatch."""
+    sk = k.shape[1]
+    if kv_chunk:
+        # The kernel path needs whole KV tiles per chunk; the jnp path
+        # merges any divisor (useful for testing the merge math).
+        if kv_chunk < 0 or sk % kv_chunk != 0 or (
+                impl != "jnp" and kv_chunk % _KV_TILE != 0):
+            raise ValueError(
+                f"kv_chunk={kv_chunk} must divide the KV length {sk} and "
+                f"(for kernel paths) be a multiple of {_KV_TILE}")
+        chunk = kv_chunk
+    elif impl != "jnp" and not _eligible(q, k):
+        chunk = _kv_chunk_for(q, k)
+    else:
+        chunk = 0
+
+    if chunk == 0 or chunk == sk:
+        out, _ = flash_block_attention(q, k, v, causal=causal, impl=impl)
+        return out
+
+    n_chunks = sk // chunk
+
+    def body(carry, i):
+        out, lse = carry
+        # Slice chunks in place — stacking a transposed (n_chunks, ...)
+        # copy would transiently double KV HBM on exactly the
+        # long-context path this exists to keep linear.
+        k_c = jax.lax.dynamic_slice_in_dim(k, i * chunk, chunk, 1)
+        v_c = jax.lax.dynamic_slice_in_dim(v, i * chunk, chunk, 1)
+        o_b, lse_b = flash_block_attention(
+            q, k_c, v_c, causal=causal, kv_offset=i * chunk, impl=impl)
+        out, lse = merge_partials(out, lse, o_b, lse_b)
+        return (out, lse), None
+
+    out0 = jnp.zeros_like(q)
+    lse0 = jnp.full((q.shape[0], q.shape[1], q.shape[2]), NEG_BIG,
+                    _compute_dtype(q))
+    (out, _), _ = jax.lax.scan(
+        body, (out0, lse0), jnp.arange(n_chunks, dtype=jnp.int32))
     return out

@@ -356,6 +356,22 @@ class TestCompiledKernelOnTPU:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
 
+    def test_compiled_chunked_long_kv(self):
+        # Over-budget KV on the real chip: auto must scan the compiled
+        # kernel over chunks and match the (chunked-jnp) oracle.
+        q, _, _ = qkv((1, 128, 1, 128), dtype=jnp.float32, seed=12)
+        rng = np.random.default_rng(13)
+        k = jnp.asarray(rng.standard_normal((1, 32768, 1, 128)) * 0.3,
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 32768, 1, 128)) * 0.3,
+                        jnp.float32)
+        assert flash._kv_chunk_for(q, k) == 8192
+        got = flash.flash_attention(q, k, v, causal=True, impl="auto")
+        want = flash.flash_attention(q, k, v, causal=True, impl="jnp",
+                                     kv_chunk=8192)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_auto_selects_pallas_and_runs(self):
         # impl='auto' on hardware must engage the compiled kernel (probe
         # passes) and agree with the oracle — the flagship-model path.
@@ -365,6 +381,83 @@ class TestCompiledKernelOnTPU:
         b = flash.flash_attention(q, k, v, causal=True, impl="jnp")
         assert flash._pallas_compiles(512, 512, 128, q.dtype, True)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestChunkedKV:
+    """The long-KV scan path of flash_attention: budget-sized chunks
+    through the block kernel, merged by the online-softmax rule — the
+    path Ulysses long context takes when one KV block would blow VMEM."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_chunked_matches_unchunked_jnp(self, causal):
+        q, k, v = qkv((1, 64, 2, 8), seed=2)   # f64: exact-oracle regime
+        a = flash.flash_attention(q, k, v, causal=causal, impl="jnp",
+                                  kv_chunk=16)
+        b = flash.flash_attention(q, k, v, causal=causal, impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_chunked_grads_match_unchunked(self):
+        q, k, v = qkv((1, 64, 2, 8), seed=4)
+
+        def loss(chunk):
+            return lambda q, k, v: jnp.sum(flash.flash_attention(
+                q, k, v, causal=True, impl="jnp", kv_chunk=chunk) ** 2)
+
+        ga = jax.grad(loss(16), argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss(0), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-9, atol=1e-11)
+
+    def test_chunked_pallas_blocks_match_oracle(self):
+        # Forced kernel path (interpret off-TPU), 2 chunks of 128.
+        q, k, v = qkv((1, 128, 1, 64), dtype=jnp.float32, seed=6)
+        k2 = jnp.concatenate([k, k * 0.5], axis=1)
+        v2 = jnp.concatenate([v, v * 2.0], axis=1)
+        a = flash.flash_attention(q, k2, v2, causal=True, impl="pallas",
+                                  kv_chunk=128)
+        b = flash.flash_attention(q, k2, v2, causal=True, impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_auto_chunks_over_budget_kv(self):
+        # 32K f32 keys at d=128 stage 32 MB — over the 8 MB budget; auto
+        # must pick the largest dividing chunk (8192) instead of falling
+        # back to the quadratic jnp path.
+        q = jnp.zeros((1, 128, 1, 128), jnp.float32)
+        k = jnp.zeros((1, 32768, 1, 128), jnp.float32)
+        assert not flash._eligible(q, k)
+        assert flash._kv_chunk_for(q, k) == 8192
+
+    def test_no_chunk_when_shape_cannot_be_eligible(self):
+        q = jnp.zeros((1, 128, 1, 8), jnp.float32)     # d too small
+        k = jnp.zeros((1, 32768, 1, 8), jnp.float32)
+        assert flash._kv_chunk_for(q, k) == 0
+        kr = jnp.zeros((1, 32700, 1, 128), jnp.float32)  # not tile-divisible
+        assert flash._kv_chunk_for(
+            jnp.zeros((1, 128, 1, 128), jnp.float32), kr) == 0
+
+    def test_bad_kv_chunk_raises(self):
+        q, k, v = qkv((1, 128, 1, 64), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="kv_chunk"):
+            flash.flash_attention(q, k, v, kv_chunk=100)
+
+    def test_long_context_end_to_end(self):
+        # A 16K-key attention through the auto-chunked scan (jnp blocks
+        # on CPU), against the dense oracle on a thin query block — the
+        # memory regime the path exists for, kept CPU-affordable.
+        q, _, _ = qkv((1, 128, 1, 128), dtype=jnp.float32, seed=8)
+        rng = np.random.default_rng(9)
+        k = jnp.asarray(rng.standard_normal((1, 16384, 1, 128)) * 0.3,
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 16384, 1, 128)) * 0.3,
+                        jnp.float32)
+        assert flash._kv_chunk_for(q, k) == 8192
+        got = flash.flash_attention(q, k, v, causal=False, impl="auto")
+        want = flash.flash_attention(q, k, v, causal=False, impl="jnp")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-5)
 
 
