@@ -260,6 +260,11 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
             vmem((1, qt, dp), lambda i, j: (i, j, 0)),
             vmem((1, qt, _STAT_LANES), lambda i, j: (i, j, 0)),
         ),
+        # Grid iterations are fully independent (the KV loop runs
+        # in-core, no cross-step scratch): declaring both dims parallel
+        # lets Mosaic pipeline and (on megacore parts) split the grid.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb)
 
@@ -454,6 +459,8 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
             vmem((1, qt, _STAT_LANES), lambda i, j: (i, j, 0)),
         ],
         out_specs=vmem((1, qt, dp), lambda i, j: (i, j, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb, dob, lse_r, dd_r)
 
@@ -479,6 +486,8 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
             vmem((1, kt, dp), lambda i, j: (i, j, 0)),
             vmem((1, kt, dp), lambda i, j: (i, j, 0)),
         ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb, dob, lse_r, dd_r)
 
