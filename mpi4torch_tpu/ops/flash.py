@@ -139,6 +139,17 @@ def _causal_n_live(qoff, kvoff, qi, qt: int, kv_tile: int, n_tiles: int):
     return jnp.clip((q_hi - kvoff) // kv_tile + 1, 0, n_tiles)
 
 
+def _parallel_grid_params():
+    """Shared CompilerParams for all three kernels: both grid dims are
+    fully independent (each step writes a distinct output block; all
+    reduction lives in in-core fori_loops), so Mosaic may pipeline the
+    grid and split it across cores on megacore parts."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
+
+
 def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 *, causal: bool, kv_tile: int, true_d: int):
     from jax.experimental import pallas as pl
@@ -260,11 +271,7 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
             vmem((1, qt, dp), lambda i, j: (i, j, 0)),
             vmem((1, qt, _STAT_LANES), lambda i, j: (i, j, 0)),
         ),
-        # Grid iterations are fully independent (the KV loop runs
-        # in-core, no cross-step scratch): declaring both dims parallel
-        # lets Mosaic pipeline and (on megacore parts) split the grid.
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+        compiler_params=_parallel_grid_params(),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb)
 
@@ -459,8 +466,7 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
             vmem((1, qt, _STAT_LANES), lambda i, j: (i, j, 0)),
         ],
         out_specs=vmem((1, qt, dp), lambda i, j: (i, j, 0)),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+        compiler_params=_parallel_grid_params(),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb, dob, lse_r, dd_r)
 
@@ -486,8 +492,7 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
             vmem((1, kt, dp), lambda i, j: (i, j, 0)),
             vmem((1, kt, dp), lambda i, j: (i, j, 0)),
         ),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+        compiler_params=_parallel_grid_params(),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb, dob, lse_r, dd_r)
 
