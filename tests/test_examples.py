@@ -125,3 +125,14 @@ def test_checkpoint_resume(tmp_path):
         np.testing.assert_array_equal(o, outs[0])
     # Converged toward y = 3x + 0.5.
     assert abs(outs[0][0] - 3.0) < 1.5 and abs(outs[0][1] - 0.5) < 1.5
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_pipeline_training(nranks):
+    # GPipe and 1F1B agree on step 1 (asserted inside main) and 1F1B
+    # training converges on every rank.
+    mod = _load("pipeline_training")
+    outs = mpi.run_ranks(mod.main, nranks)
+    for losses in outs:
+        assert losses == outs[0]
+        assert losses[-1] < 0.7 * losses[0]
