@@ -136,3 +136,13 @@ def test_pipeline_training(nranks):
     for losses in outs:
         assert losses == outs[0]
         assert losses[-1] < 0.7 * losses[0]
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_tensor_parallel_mlp(nranks):
+    # TP trajectory matches the single-device oracle at every step
+    # (asserted inside main); rank-count invariant.
+    mod = _load("tensor_parallel_mlp")
+    outs = mpi.run_ranks(mod.main, nranks)
+    for losses in outs:
+        assert losses == outs[0]
