@@ -101,13 +101,18 @@ def main():
         np.testing.assert_allclose(float(l), ref_losses[step],
                                    rtol=1e-10, atol=1e-12)
 
-    # Final sharded params equal the oracle's corresponding shards.
+    # Final sharded params equal the oracle's corresponding shards —
+    # every leaf: both feature shards, the sharded bias, and the
+    # replicated bias.
     r = int(comm.rank)
-    n = comm.size
     f_lo = r * (D_FF // n)
-    np.testing.assert_allclose(
-        np.asarray(local["w1"]),
-        np.asarray(ref["w1"][:, f_lo:f_lo + D_FF // n]), rtol=1e-10)
+    sl = slice(f_lo, f_lo + D_FF // n)
+    np.testing.assert_allclose(np.asarray(local["w1"]),
+                               np.asarray(ref["w1"][:, sl]), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(local["b1"]),
+                               np.asarray(ref["b1"][sl]), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(local["w2"]),
+                               np.asarray(ref["w2"][sl, :]), rtol=1e-10)
     np.testing.assert_allclose(np.asarray(local["b2"]),
                                np.asarray(ref["b2"]), rtol=1e-10)
     if r == 0:
