@@ -2,6 +2,7 @@
 
 from .checkpoint import CheckpointManager, restore_checkpoint, save_checkpoint
 from .lbfgs import LBFGS, minimize_lbfgs
+from .profiling import profiler_trace
 
 __all__ = ["LBFGS", "minimize_lbfgs", "CheckpointManager",
-           "restore_checkpoint", "save_checkpoint"]
+           "restore_checkpoint", "save_checkpoint", "profiler_trace"]

@@ -1,0 +1,43 @@
+"""Profiling convenience: capture a device trace with the op spans on.
+
+The reference's only observability surface is its autograd node names
+showing up in torch's profiler (SURVEY.md §5 tracing; reference:
+csrc/extension.cpp:256-258).  Here every facade op already runs under a
+``jax.named_scope`` (comm.py) and every SPMD collective adjoint under an
+explicit ``...Backward`` scope (ops/spmd.py), so any JAX profiler trace
+carries ``mpi4torch.Allreduce``-style spans; this module only packages
+the capture:
+
+    from mpi4torch_tpu.utils import profiler_trace
+
+    with profiler_trace("/tmp/trace"):
+        step(params, batch)           # compiled or eager work
+
+    # -> /tmp/trace/plugins/profile/<run>/*.xplane.pb, viewable with
+    #    TensorBoard's profile plugin or xprof / Perfetto.
+
+On TPU the trace includes per-core timelines, HLO op breakdowns, and the
+collective/ICI activity the named scopes label; on CPU it still records
+host-side XLA execution (the harness smoke path, tests/test_observability).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["profiler_trace"]
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: str):
+    """Capture a JAX profiler trace of the enclosed block into ``logdir``.
+
+    Delegates to ``jax.profiler.trace`` (exception-safe: the capture
+    stops when the block exits either way) — this package's value is the
+    op-span discipline documented above, not the capture mechanics.
+    Traces from multiple processes of one ``init_distributed`` job may
+    share a ``logdir`` — files are keyed by host."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
