@@ -77,30 +77,41 @@ def _chip_specs(device_kind: str):
     return _DEFAULT_PEAK, _DEFAULT_HBM
 
 
-def _probe_tpu(timeout: float = 300.0):
+def _probe_tpu(timeout: float = 300.0, attempts: int = 3,
+               retry_wait: float = 60.0):
     """Initialize the TPU backend in a THROWAWAY subprocess.
 
     Returns ``(device_kind, n_devices)`` if a TPU came up, else None.
     Round 1 lost both driver artifacts to this init hanging (rc=124) or
-    raising (rc=1) in-process; a subprocess is the only safe probe."""
+    raising (rc=1) in-process; a subprocess is the only safe probe.
+    The tunnel also has transient outages measured in minutes (observed
+    in round 4: reachable, then ~an hour of hung/UNAVAILABLE inits, then
+    reachable again) — so a failed probe is retried a bounded number of
+    times before the bench concedes to the CPU fallback."""
     code = (
         "import jax; d = jax.devices(); "
         "print(d[0].platform + '|' + d[0].device_kind + '|' + str(len(d)))"
     )
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True, timeout=timeout)
-    except (subprocess.TimeoutExpired, OSError):
-        return None
-    if r.returncode != 0:
-        return None
-    try:
-        platform, kind, n = r.stdout.strip().splitlines()[-1].split("|")
-    except ValueError:
-        return None
-    if platform != "tpu":
-        return None
-    return kind, int(n)
+    for attempt in range(attempts):
+        if attempt:
+            _note(f"tpu probe retry {attempt + 1}/{attempts} "
+                  f"in {retry_wait:.0f}s")
+            time.sleep(retry_wait)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout)
+        except (subprocess.TimeoutExpired, OSError):
+            continue
+        if r.returncode != 0:
+            continue
+        try:
+            platform, kind, n = r.stdout.strip().splitlines()[-1].split("|")
+        except ValueError:
+            continue
+        if platform == "tpu":
+            return kind, int(n)
+    return None
 
 
 def _timeit(fn, *args, iters: int):
