@@ -55,6 +55,7 @@ class TransformerConfig:
     n_layers: int
     d_ff: int
     max_seq: int
+    n_kv_heads: int = 0
     n_experts: int = 0
     capacity: int = 0
     aux_coef: float = 0.01
@@ -67,6 +68,17 @@ class TransformerConfig:
             raise ValueError(
                 f"n_experts={self.n_experts} requires capacity > 0, got "
                 f"{self.capacity}")
+        if self.n_kv_heads:
+            # Grouped-query attention (ops/flash.py): q head h reads KV
+            # head h // (n_heads // n_kv_heads).  0 = plain MHA.
+            if self.n_kv_heads < 0 or self.n_heads % self.n_kv_heads != 0:
+                raise ValueError(
+                    f"n_heads={self.n_heads} must be a positive multiple "
+                    f"of n_kv_heads={self.n_kv_heads}")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def init_transformer(key, cfg: TransformerConfig,
@@ -88,10 +100,14 @@ def init_transformer(key, cfg: TransformerConfig,
         "blocks": [],
     }
     for _ in range(n_layers):
+        # Fused projection: h q-heads plus 2*h_kv KV heads (= 3*d_model
+        # for plain MHA; smaller under GQA).
+        hd = d_model // cfg.n_heads
         blk = {
             "ln1": {"scale": jnp.ones((d_model,), dtype),
                     "bias": jnp.zeros((d_model,), dtype)},
-            "wqkv": dense(next(keys), d_model, 3 * d_model),
+            "wqkv": dense(next(keys), d_model,
+                          d_model + 2 * cfg.kv_heads * hd),
             "wo": dense(next(keys), d_model, d_model),
             "ln2": {"scale": jnp.ones((d_model,), dtype),
                     "bias": jnp.zeros((d_model,), dtype)},
@@ -166,12 +182,18 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     d = x.shape[-1]
     aux_total = jnp.zeros((), x.dtype)
 
+    h_kv = cfg.kv_heads
+    hd = cfg.d_model // h
+
     def block_fn(x, blk):
         y = _layer_norm(x, blk["ln1"])
         qkv = y @ blk["wqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        split = lambda t: t.reshape(b, s_local, h, d // h)
-        o = _attention(split(q), split(k), split(v), comm_sp, attn)
+        q = qkv[..., :h * hd]
+        k = qkv[..., h * hd:(h + h_kv) * hd]
+        v = qkv[..., (h + h_kv) * hd:]
+        split = lambda t, nh: t.reshape(b, s_local, nh, hd)
+        o = _attention(split(q, h), split(k, h_kv), split(v, h_kv),
+                       comm_sp, attn)
         x = x + o.reshape(b, s_local, d) @ blk["wo"]
         y = _layer_norm(x, blk["ln2"])
         if cfg.n_experts > 0:

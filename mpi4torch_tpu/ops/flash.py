@@ -99,10 +99,46 @@ def _compute_dtype(q):
     return jnp.promote_types(q.dtype, jnp.float32)
 
 
+def _gqa_groups(q, k) -> int:
+    """Query heads per KV head (grouped-query attention).  1 = plain MHA;
+    q head ``h`` attends through KV head ``h // g`` (the repeat-interleave
+    convention).  Head counts are validated once at the public entry
+    (:func:`flash_block_attention`)."""
+    return q.shape[2] // k.shape[2]
+
+
+def _group_repeat_kv(k, g: int):
+    """(b, sk, h_kv, d) -> (b, sk, h_kv*g, d) with each KV head repeated
+    ``g`` times consecutively — the jnp/oracle realization of the
+    ``h // g`` mapping.  The kernels never do this: their KV BlockSpec
+    index maps point q-head grid rows straight at the shared KV head, so
+    GQA's HBM saving is real on the kernel path."""
+    return k if g == 1 else jnp.repeat(k, g, axis=2)
+
+
+def _group_sum(dkv, b: int, h_kv: int, g: int):
+    """Sum per-q-head dk/dv partials back onto the shared KV heads:
+    (b, sk, h_kv*g, d) -> (b, sk, h_kv, d)."""
+    if g == 1:
+        return dkv
+    sk, d = dkv.shape[1], dkv.shape[3]
+    return dkv.reshape(b, sk, h_kv, g, d).sum(axis=3)
+
+
+def _kv_row(i, h: int, h_kv: int, g: int):
+    """BlockSpec index-map arithmetic shared by all three kernels: grid
+    rows walk q heads (``b*h`` rows, head-minor); the KV operand row for
+    q-head grid row ``i`` is its batch's shared KV head ``(i % h) // g``
+    — GQA resolved in the index map, so KV is never duplicated in HBM."""
+    return (i // h) * h_kv + (i % h) // g
+
+
 def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
     ct = _compute_dtype(q)
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    g = _gqa_groups(q, k)
+    k, v = _group_repeat_kv(k, g), _group_repeat_kv(v, g)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, ct))
     s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(ct), k.astype(ct)) * scale
     if causal:
@@ -221,14 +257,15 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, h_kv = k.shape[1], k.shape[2]
+    g = _gqa_groups(q, k)
     bh = b * h
     qt = min(_Q_TILE, sq)
     kt = min(_KV_TILE, sk)
     dp = _lane_pad(d)
 
-    def to_bh(x, s):
-        x = x.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    def to_bh(x, s, nh):
+        x = x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
         if dp != d:
             # Zero-pad head_dim to the lane width.  Zeros leave every dot
             # product unchanged (scores and PV columns), so only the
@@ -236,7 +273,10 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
             x = jnp.pad(x, ((0, 0), (0, 0), (0, dp - d)))
         return x
 
-    qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
+    kv_row = functools.partial(_kv_row, h=h, h_kv=h_kv, g=g)
+
+    qb = to_bh(q, sq, h)
+    kb, vb = to_bh(k, sk, h_kv), to_bh(v, sk, h_kv)
     qoff = jnp.asarray(q_off, jnp.int32).reshape(1, 1)
     kvoff = jnp.asarray(kv_off, jnp.int32).reshape(1, 1)
 
@@ -264,8 +304,8 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
             smem((1, 1), lambda i, j: (0, 0)),
             smem((1, 1), lambda i, j: (0, 0)),
             vmem((1, qt, dp), lambda i, j: (i, j, 0)),
-            vmem((1, sk, dp), lambda i, j: (i, 0, 0)),
-            vmem((1, sk, dp), lambda i, j: (i, 0, 0)),
+            vmem((1, sk, dp), lambda i, j: (kv_row(i), 0, 0)),
+            vmem((1, sk, dp), lambda i, j: (kv_row(i), 0, 0)),
         ],
         out_specs=(
             vmem((1, qt, dp), lambda i, j: (i, j, 0)),
@@ -425,24 +465,27 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, h_kv = k.shape[1], k.shape[2]
+    g = _gqa_groups(q, k)
     bh = b * h
     qt = min(_Q_TILE, sq)
     kt = min(_KV_TILE, sk)
     dp = _lane_pad(d)
 
-    def to_bh(x, s):
-        x = x.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    def to_bh(x, s, nh):
+        x = x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
         if dp != d:
             x = jnp.pad(x, ((0, 0), (0, 0), (0, dp - d)))
         return x
+
+    kv_row = functools.partial(_kv_row, h=h, h_kv=h_kv, g=g)
 
     def rows(x):  # (b, sq, h) -> (bh, sq, _STAT_LANES) f32, lane-broadcast
         x = x.astype(jnp.float32).transpose(0, 2, 1).reshape(bh, sq)
         return jnp.broadcast_to(x[..., None], (bh, sq, _STAT_LANES))
 
-    qb, dob = to_bh(q, sq), to_bh(do, sq)
-    kb, vb = to_bh(k, sk), to_bh(v, sk)
+    qb, dob = to_bh(q, sq, h), to_bh(do, sq, h)
+    kb, vb = to_bh(k, sk, h_kv), to_bh(v, sk, h_kv)
     lse_r, dd_r = rows(lse), rows(dd)
     qoff = jnp.asarray(q_off, jnp.int32).reshape(1, 1)
     kvoff = jnp.asarray(kv_off, jnp.int32).reshape(1, 1)
@@ -459,8 +502,8 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
             smem((1, 1), lambda i, j: (0, 0)),
             smem((1, 1), lambda i, j: (0, 0)),
             vmem((1, qt, dp), lambda i, j: (i, j, 0)),
-            vmem((1, sk, dp), lambda i, j: (i, 0, 0)),
-            vmem((1, sk, dp), lambda i, j: (i, 0, 0)),
+            vmem((1, sk, dp), lambda i, j: (kv_row(i), 0, 0)),
+            vmem((1, sk, dp), lambda i, j: (kv_row(i), 0, 0)),
             vmem((1, qt, dp), lambda i, j: (i, j, 0)),
             vmem((1, qt, _STAT_LANES), lambda i, j: (i, j, 0)),
             vmem((1, qt, _STAT_LANES), lambda i, j: (i, j, 0)),
@@ -470,20 +513,28 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb, dob, lse_r, dd_r)
 
-    dk, dv = pl.pallas_call(
+    # Under GQA (g > 1) the dkv grid still walks q heads: each grid row
+    # reads its shared KV head (kv_row) and writes a PER-Q-HEAD partial;
+    # the g partials per KV head are summed outside the kernel.  Partials
+    # are f32 so the cross-group sum accumulates at the same precision as
+    # the in-kernel fori_loop (transient cost: g x f32 dk/dv, freed by
+    # the sum — KV itself is still never duplicated).
+    dk_p, dv_p = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, q_tile=qt,
                           true_d=d),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, sk, dp), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, dp), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, dp),
+                                 k.dtype if g == 1 else jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, dp),
+                                 v.dtype if g == 1 else jnp.float32),
         ),
         grid=(bh, sk // kt),
         in_specs=[
             smem((1, 1), lambda i, j: (0, 0)),
             smem((1, 1), lambda i, j: (0, 0)),
             vmem((1, sq, dp), lambda i, j: (i, 0, 0)),
-            vmem((1, kt, dp), lambda i, j: (i, j, 0)),
-            vmem((1, kt, dp), lambda i, j: (i, j, 0)),
+            vmem((1, kt, dp), lambda i, j: (kv_row(i), j, 0)),
+            vmem((1, kt, dp), lambda i, j: (kv_row(i), j, 0)),
             vmem((1, sq, dp), lambda i, j: (i, 0, 0)),
             vmem((1, sq, _STAT_LANES), lambda i, j: (i, 0, 0)),
             vmem((1, sq, _STAT_LANES), lambda i, j: (i, 0, 0)),
@@ -495,13 +546,21 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
         compiler_params=_parallel_grid_params(),
         interpret=interpret,
     )(qoff, kvoff, qb, kb, vb, dob, lse_r, dd_r)
+    if g == 1:
+        dk, dv = dk_p, dv_p
+    else:
+        def gsum(p, dtype):
+            p = p.reshape(b, h_kv, g, sk, dp).sum(axis=2)
+            return p.reshape(b * h_kv, sk, dp).astype(dtype)
+        dk, dv = gsum(dk_p, k.dtype), gsum(dv_p, v.dtype)
 
-    def from_bh(x, s):
+    def from_bh(x, s, nh):
         if dp != d:
             x = x[:, :, :d]
-        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        return x.reshape(b, nh, s, d).transpose(0, 2, 1, 3)
 
-    return from_bh(dq, sq), from_bh(dk, sk), from_bh(dv, sk)
+    return (from_bh(dq, sq, h), from_bh(dk, sk, h_kv),
+            from_bh(dv, sk, h_kv))
 
 
 def _bwd_eligible(q, k) -> bool:
@@ -522,17 +581,18 @@ def _bwd_eligible(q, k) -> bool:
     return staged <= _KV_VMEM_BUDGET
 
 
-def _pallas_bwd_compiles(sq, sk, d, dtype, causal: bool) -> bool:
+def _pallas_bwd_compiles(sq, sk, d, dtype, causal: bool,
+                         g: int = 1) -> bool:
     # _pallas_bwd takes (q, k, v, do, lse, dd, ...): do mirrors q, and the
     # two row stats are (b, sq, h) f32.
     def args(sq, d, dtype):
-        x = jax.ShapeDtypeStruct((1, sq, 1, d), dtype)
-        r = jax.ShapeDtypeStruct((1, sq, 1), jnp.float32)
+        x = jax.ShapeDtypeStruct((1, sq, g, d), dtype)
+        r = jax.ShapeDtypeStruct((1, sq, g), jnp.float32)
         return (x, r, r)
 
     return _probe_compiles(_BWD_PROBE_CACHE, _pallas_bwd,
                            args(sq, d, dtype), "backward",
-                           sq, sk, d, dtype, causal)
+                           sq, sk, d, dtype, causal, g)
 
 
 # ---------------------------------------------------------------------------
@@ -553,12 +613,13 @@ _BWD_PROBE_CACHE: dict = {}
 
 
 def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
-                    causal: bool) -> bool:
+                    causal: bool, g: int = 1) -> bool:
     """Shared one-time compile probe (forward and backward kernels): the
-    block shapes depend only on (sq, sk, d, dtype, causal), so a
-    batch/head-reduced instance (tiny grid) proves lowering for the whole
-    family."""
-    key = (sq, sk, d, jnp.dtype(dtype).name, causal)
+    block shapes depend only on (sq, sk, d, dtype, causal) — plus the GQA
+    group count ``g``, which changes the KV index maps and (backward) the
+    partial-output dtype — so a batch/head-reduced instance (q heads =
+    g, one KV head; tiny grid) proves lowering for the whole family."""
+    key = (sq, sk, d, jnp.dtype(dtype).name, causal, g)
     ok = cache.get(key)
     if ok is None:
         import warnings
@@ -567,7 +628,7 @@ def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
             probe = jax.jit(functools.partial(
                 fn, q_off=jnp.int32(0), kv_off=jnp.int32(0),
                 causal=causal, interpret=False))
-            q = jax.ShapeDtypeStruct((1, sq, 1, d), dtype)
+            q = jax.ShapeDtypeStruct((1, sq, g, d), dtype)
             kv = jax.ShapeDtypeStruct((1, sk, 1, d), dtype)
             probe.lower(q, kv, kv, *extra_args).compile()
             ok = True
@@ -583,9 +644,9 @@ def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
     return ok
 
 
-def _pallas_compiles(sq, sk, d, dtype, causal: bool) -> bool:
+def _pallas_compiles(sq, sk, d, dtype, causal: bool, g: int = 1) -> bool:
     return _probe_compiles(_PROBE_CACHE, _pallas_block, (), "forward",
-                           sq, sk, d, dtype, causal)
+                           sq, sk, d, dtype, causal, g)
 
 
 def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str):
@@ -603,7 +664,7 @@ def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str):
     # auto
     if (_eligible(q, k) and _on_tpu()
             and _pallas_compiles(q.shape[1], k.shape[1], q.shape[3],
-                                 q.dtype, causal)):
+                                 q.dtype, causal, _gqa_groups(q, k))):
         return _pallas_block(q, k, v, q_off, kv_off, causal, interpret=False)
     return _jnp_block(q, k, v, q_off, kv_off, causal)
 
@@ -670,7 +731,7 @@ def _block_bwd(causal, impl, res, cot):
         use_kernel = (
             _bwd_eligible(q, k) and _on_tpu()
             and _pallas_bwd_compiles(q.shape[1], k.shape[1], q.shape[3],
-                                     q.dtype, causal))
+                                     q.dtype, causal, _gqa_groups(q, k)))
     if use_kernel:
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1)                          # (b, sq, h)
@@ -682,9 +743,13 @@ def _block_bwd(causal, impl, res, cot):
 
     f32 = _compute_dtype(q)
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, h_kv = k.shape[1], k.shape[2]
+    g = _gqa_groups(q, k)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, f32))
     qf, kf, vf = q.astype(f32), k.astype(f32), v.astype(f32)
+    # GQA on the oracle path: compute as MHA against repeated KV, then
+    # sum each group's dk/dv back onto its shared KV head at the end.
+    kf, vf = _group_repeat_kv(kf, g), _group_repeat_kv(vf, g)
     do = do.astype(f32)
     lse = lse.astype(f32)
     dlse = dlse.astype(f32)
@@ -714,6 +779,7 @@ def _block_bwd(causal, impl, res, cot):
             0, sk // kt, body,
             (jnp.zeros_like(qf), jnp.zeros_like(kf), jnp.zeros_like(vf)))
 
+    dk, dv = _group_sum(dk, b, h_kv, g), _group_sum(dv, b, h_kv, g)
     zero_off = _zero_offsets(q_off)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             zero_off, zero_off)
@@ -727,7 +793,12 @@ def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
                           ) -> Tuple[jax.Array, jax.Array]:
     """Normalized attention partials of ``q`` against one KV block.
 
-    Args are ``(batch, seq, heads, head_dim)``; offsets are the *integer*
+    Args are ``(batch, seq, heads, head_dim)``.  Grouped-query attention:
+    ``k``/``v`` may carry fewer heads than ``q`` (any divisor); q head
+    ``h`` attends through KV head ``h // (h_q // h_kv)``.  The Pallas
+    kernels resolve the grouping in their KV BlockSpec index maps (KV is
+    never duplicated in HBM); the jnp path realizes it by KV repeat (it
+    is the memory-unconstrained oracle).  Offsets are the *integer*
     global positions of the first query/key (may be traced; exact to
     2^31-1 — float inputs are truncated to int32, losing exactness past
     2^24 before the cast).  Returns
@@ -738,6 +809,16 @@ def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
     TPU — for tests), ``"jnp"``."""
     if impl not in ("auto", "pallas", "jnp"):
         raise ValueError(f"unknown impl {impl!r}")
+    if k.shape != v.shape or q.shape[0] != k.shape[0] \
+            or q.shape[3] != k.shape[3]:
+        raise ValueError(
+            f"q{q.shape} and k{k.shape}/v{v.shape} must agree on batch "
+            f"and head_dim, and k/v must match")
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"query heads ({q.shape[2]}) must be a multiple of KV heads "
+            f"({k.shape[2]}) — grouped-query attention maps q head h to "
+            f"KV head h // (h_q // h_kv)")
     q_off = jnp.asarray(q_offset, jnp.int32)
     kv_off = jnp.asarray(kv_offset, jnp.int32)
     return _block(q, k, v, q_off, kv_off, causal, impl)

@@ -124,16 +124,19 @@ def ulysses_attention(comm, q, k, v, causal: bool = False,
 
     size = comm.size
     b, s_local, h, d = q.shape
-    if h % size != 0:
+    h_kv = k.shape[2]
+    # GQA: k/v may carry fewer heads; both head counts split over the
+    # ranks, so each rank keeps whole q-head groups aligned with their
+    # shared KV heads (q heads h*g..h*g+g-1 land with KV head h).
+    if h % size != 0 or h_kv % size != 0:
         raise ValueError(
-            f"ulysses_attention needs heads ({h}) divisible by the "
-            f"communicator size ({size})")
-    h_local = h // size
+            f"ulysses_attention needs q heads ({h}) and KV heads "
+            f"({h_kv}) divisible by the communicator size ({size})")
 
     def to_heads(x):
-        # (b, s_local, h, d) -> (b, s_global, h/size, d)
+        # (b, s_local, nh, d) -> (b, s_global, nh/size, d)
         return comm.Alltoall(x, gatheraxis=1, scatteraxis=2,
-                             numelem=h_local)
+                             numelem=x.shape[2] // size)
 
     def to_seq(x):
         return comm.Alltoall(x, gatheraxis=2, scatteraxis=1,

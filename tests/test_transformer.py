@@ -134,6 +134,38 @@ def test_remat_single_device_grads_match():
         g1, g0)
 
 
+@pytest.mark.parametrize("attn,dp,sp", [("ring", 2, 4), ("ulysses", 4, 2)])
+def test_gqa_2d_mesh_matches_single_process(attn, dp, sp):
+    """Grouped-query attention (n_kv_heads < n_heads) through the full
+    distributed step: the 2D-mesh GQA transformer must reproduce the
+    single-process GQA run exactly.  Ulysses additionally needs the KV
+    head count divisible by sp (each rank keeps whole q-head groups)."""
+    cfg = dataclasses.replace(CFG, n_kv_heads=2)
+    assert cfg.kv_heads % sp == 0 or attn == "ring"
+    params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                dtype=jnp.float64)
+    hd = cfg.d_model // cfg.n_heads
+    assert params["blocks"][0]["wqkv"].shape == (
+        cfg.d_model, cfg.d_model + 2 * 2 * hd)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab)
+    ref_loss, ref_params = T.train_step(cfg, params, tokens)
+
+    loss, new_params = make_mesh_step(cfg, dp, sp, attn)(params, tokens)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-12, atol=1e-14)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-11),
+        new_params, ref_params)
+
+
+def test_gqa_bad_head_ratio_raises():
+    with pytest.raises(ValueError, match="multiple of n_kv_heads"):
+        dataclasses.replace(CFG, n_kv_heads=3)
+
+
 def test_forward_shapes_and_unknown_strategy():
     params, tokens = setup()
     logits = T.forward(CFG, params, tokens)
