@@ -56,6 +56,7 @@ class TransformerConfig:
     d_ff: int
     max_seq: int
     n_kv_heads: int = 0
+    attn_window: int = 0
     n_experts: int = 0
     capacity: int = 0
     aux_coef: float = 0.01
@@ -75,6 +76,11 @@ class TransformerConfig:
                 raise ValueError(
                     f"n_heads={self.n_heads} must be a positive multiple "
                     f"of n_kv_heads={self.n_kv_heads}")
+
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0 (0 = full causal attention), "
+                f"got {self.attn_window}")
 
     @property
     def kv_heads(self) -> int:
@@ -128,14 +134,14 @@ def _layer_norm(x, p):
     return (x - mu) / jnp.sqrt(var + 1e-5) * p["scale"] + p["bias"]
 
 
-def _attention(q, k, v, comm_sp, attn: str):
+def _attention(q, k, v, comm_sp, attn: str, window: int = 0):
     if attn not in ("dense", "ring", "ulysses"):
         raise ValueError(f"unknown attention strategy {attn!r}")
     if comm_sp is None or comm_sp.size == 1:
         # The fused flash path: Pallas kernel on eligible TPU shapes
         # (scores never hit HBM), jnp otherwise — numerically the same
         # softmax as :func:`dense_attention`, which stays the test oracle.
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, window=window)
     if attn == "dense":
         raise ValueError(
             "attn='dense' cannot see across sequence shards: with a "
@@ -145,8 +151,8 @@ def _attention(q, k, v, comm_sp, attn: str):
             "comm_sp=None with the full sequence."
         )
     if attn == "ring":
-        return ring_attention(comm_sp, q, k, v, causal=True)
-    return ulysses_attention(comm_sp, q, k, v, causal=True)
+        return ring_attention(comm_sp, q, k, v, causal=True, window=window)
+    return ulysses_attention(comm_sp, q, k, v, causal=True, window=window)
 
 
 def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
@@ -193,7 +199,7 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
         v = qkv[..., (h + h_kv) * hd:]
         split = lambda t, nh: t.reshape(b, s_local, nh, hd)
         o = _attention(split(q, h), split(k, h_kv), split(v, h_kv),
-                       comm_sp, attn)
+                       comm_sp, attn, cfg.attn_window)
         x = x + o.reshape(b, s_local, d) @ blk["wo"]
         y = _layer_norm(x, blk["ln2"])
         if cfg.n_experts > 0:

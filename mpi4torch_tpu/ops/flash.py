@@ -133,7 +133,7 @@ def _kv_row(i, h: int, h_kv: int, g: int):
     return (i // h) * h_kv + (i % h) // g
 
 
-def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
+def _jnp_block(q, k, v, q_off, kv_off, causal: bool, window: int = 0):
     ct = _compute_dtype(q)
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -145,6 +145,10 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool):
         q_pos = q_off + jnp.arange(sq, dtype=jnp.int32)
         kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
         mask = q_pos[:, None] >= kv_pos[None, :]
+        if window:
+            # Sliding window: q attends the last `window` positions
+            # (itself included) — q_pos - window < kv_pos <= q_pos.
+            mask &= (q_pos[:, None] - kv_pos[None, :]) < window
         s = jnp.where(mask[None, :, None, :], s, NEG_BIG)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -175,6 +179,18 @@ def _causal_n_live(qoff, kvoff, qi, qt: int, kv_tile: int, n_tiles: int):
     return jnp.clip((q_hi - kvoff) // kv_tile + 1, 0, n_tiles)
 
 
+def _window_start_tile(qoff, kvoff, qi, qt: int, kv_tile: int,
+                       window: int, n_tiles: int):
+    """First KV tile that can contain in-window positions for q tile
+    ``qi`` under a sliding window: the tile holding position
+    ``q_lo - window + 1`` (this q tile's FIRST query's earliest visible
+    key).  Earlier tiles are fully below every query's window — skipping
+    them makes windowed attention cost O(window), not O(seq), per query
+    tile.  Same exact-neutrality argument as :func:`_causal_n_live`."""
+    q_lo = qoff + qi * qt
+    return jnp.clip((q_lo - window + 1 - kvoff) // kv_tile, 0, n_tiles)
+
+
 def _parallel_grid_params():
     """Shared CompilerParams for all three kernels: both grid dims are
     fully independent (each step writes a distinct output block; all
@@ -187,7 +203,8 @@ def _parallel_grid_params():
 
 
 def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, causal: bool, kv_tile: int, true_d: int):
+                *, causal: bool, kv_tile: int, true_d: int,
+                window: int = 0):
     from jax.experimental import pallas as pl
 
     f32 = jnp.float32
@@ -219,6 +236,8 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             kv_pos = (kvoff_ref[0, 0] + j * kv_tile
                       + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
             mask = q_pos >= kv_pos                           # (QT, KT)
+            if window:
+                mask &= (q_pos - kv_pos) < window
             s = jnp.where(mask, s, NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -236,7 +255,10 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc0 = jnp.zeros((qt, d), f32)
     n_live = (_causal_n_live(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
                              kv_tile, n_kv) if causal else n_kv)
-    m, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
+    j0 = (_window_start_tile(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
+                             kv_tile, window, n_kv)
+          if (causal and window) else 0)
+    m, l, acc = jax.lax.fori_loop(j0, n_live, body, (m0, l0, acc0))
 
     nonzero = l > 0
     safe_l = jnp.where(nonzero, l, 1.0)
@@ -252,7 +274,8 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                                           (0, 1))
 
 
-def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
+def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool,
+                  window: int = 0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -285,7 +308,7 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool):
     vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, kv_tile=kt,
-                          true_d=d),
+                          true_d=d, window=window),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sq, dp), q.dtype),
             # lse rides lane-broadcast as (bh, sq, _STAT_LANES): Mosaic
@@ -344,7 +367,7 @@ def _stat_tile(x, width: int):
 
 
 def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
-              causal: bool, scale):
+              causal: bool, scale, window: int = 0):
     """Recompute p and ds for one (q-tile, kv-tile) pair, in-kernel.
 
     ``lse`` and ``dd = delta - dlse`` arrive as (QT, KT) lane-broadcast
@@ -361,6 +384,8 @@ def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
     p = jnp.exp(s - lse_t)
     if causal:
         mask = q_pos >= kv_pos                                    # (QT, KT)
+        if window:
+            mask &= (q_pos - kv_pos) < window
         p = jnp.where(mask, p, 0.0)
     dp_ = jax.lax.dot_general(do_t, v_t, (((1,), (1,)), ((), ())),
                               preferred_element_type=f32)
@@ -370,7 +395,8 @@ def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
 
 def _bwd_dq_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
                    lse_ref, dd_ref, dq_ref,
-                   *, causal: bool, kv_tile: int, true_d: int):
+                   *, causal: bool, kv_tile: int, true_d: int,
+                   window: int = 0):
     from jax.experimental import pallas as pl
 
     f32, i32 = jnp.float32, jnp.int32
@@ -392,21 +418,25 @@ def _bwd_dq_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
         kv_pos = (kvoff_ref[0, 0] + j * kv_tile
                   + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
         _, ds = _bwd_p_ds(qb, kb, vb, dob, lse_t, dd_t,
-                          q_pos, kv_pos, causal, scale)
+                          q_pos, kv_pos, causal, scale, window)
         return dq + jax.lax.dot_general(
             ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=f32) * scale
 
+    n_kv = sk // kv_tile
     n_live = (_causal_n_live(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
-                             kv_tile, sk // kv_tile)
-              if causal else sk // kv_tile)
-    dq = jax.lax.fori_loop(0, n_live, body, jnp.zeros((qt, d), f32))
+                             kv_tile, n_kv) if causal else n_kv)
+    j0 = (_window_start_tile(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
+                             kv_tile, window, n_kv)
+          if (causal and window) else 0)
+    dq = jax.lax.fori_loop(j0, n_live, body, jnp.zeros((qt, d), f32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
                     lse_ref, dd_ref, dk_ref, dv_ref,
-                    *, causal: bool, q_tile: int, true_d: int):
+                    *, causal: bool, q_tile: int, true_d: int,
+                    window: int = 0):
     from jax.experimental import pallas as pl
 
     f32, i32 = jnp.float32, jnp.int32
@@ -430,7 +460,7 @@ def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
         q_pos = (qoff_ref[0, 0] + i * q_tile
                  + jax.lax.broadcasted_iota(i32, (q_tile, 1), 0))
         p, ds = _bwd_p_ds(q_t, kb, vb, do_t, lse_t, dd_t,
-                          q_pos, kv_pos, causal, scale)
+                          q_pos, kv_pos, causal, scale, window)
         dv = dv + jax.lax.dot_general(
             p.astype(do_t.dtype), do_t, (((0,), (0,)), ((), ())),
             preferred_element_type=f32)                    # (KT, D)
@@ -440,23 +470,32 @@ def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
         return dk, dv
 
     dk0 = jnp.zeros((kt, d), f32)
+    n_q = sq // q_tile
     if causal:
         # Mirror cut: q tile i contributes iff its last position reaches
         # this KV block's first position — start the loop at the
         # diagonal.  i_min = floor((kv_lo - qoff) / q_tile) (clipped), the
         # first tile whose max q_pos >= kv_lo.
         kv_lo = kvoff_ref[0, 0] + ki * kt
-        i_start = jnp.clip((kv_lo - qoff_ref[0, 0]) // q_tile, 0,
-                           sq // q_tile)
+        i_start = jnp.clip((kv_lo - qoff_ref[0, 0]) // q_tile, 0, n_q)
     else:
         i_start = 0
-    dk, dv = jax.lax.fori_loop(i_start, sq // q_tile, body, (dk0, dk0))
+    if causal and window:
+        # Window mirror cut: the farthest query still inside any of this
+        # KV tile's windows sits at kv_hi + window - 1 — stop after its
+        # tile.
+        kv_hi = kvoff_ref[0, 0] + (ki + 1) * kt - 1
+        i_end = jnp.clip((kv_hi + window - 1 - qoff_ref[0, 0]) // q_tile
+                         + 1, 0, n_q)
+    else:
+        i_end = n_q
+    dk, dv = jax.lax.fori_loop(i_start, i_end, body, (dk0, dk0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
-                causal: bool, interpret: bool):
+                causal: bool, interpret: bool, window: int = 0):
     """Fused dq/dk/dv.  Layout/staging mirrors ``_pallas_block``; the row
     statistics (lse, delta, dlse) ride lane-broadcast as
     (bh, sq, _STAT_LANES) f32 — the same Mosaic-proven scheme as the
@@ -495,7 +534,7 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, kv_tile=kt,
-                          true_d=d),
+                          true_d=d, window=window),
         out_shape=jax.ShapeDtypeStruct((bh, sq, dp), q.dtype),
         grid=(bh, sq // qt),
         in_specs=[
@@ -521,7 +560,7 @@ def _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
     # the sum — KV itself is still never duplicated).
     dk_p, dv_p = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, q_tile=qt,
-                          true_d=d),
+                          true_d=d, window=window),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, dp),
                                  k.dtype if g == 1 else jnp.float32),
@@ -582,7 +621,7 @@ def _bwd_eligible(q, k) -> bool:
 
 
 def _pallas_bwd_compiles(sq, sk, d, dtype, causal: bool,
-                         g: int = 1) -> bool:
+                         g: int = 1, window: int = 0) -> bool:
     # _pallas_bwd takes (q, k, v, do, lse, dd, ...): do mirrors q, and the
     # two row stats are (b, sq, h) f32.
     def args(sq, d, dtype):
@@ -592,7 +631,7 @@ def _pallas_bwd_compiles(sq, sk, d, dtype, causal: bool,
 
     return _probe_compiles(_BWD_PROBE_CACHE, _pallas_bwd,
                            args(sq, d, dtype), "backward",
-                           sq, sk, d, dtype, causal, g)
+                           sq, sk, d, dtype, causal, g, window)
 
 
 # ---------------------------------------------------------------------------
@@ -613,13 +652,16 @@ _BWD_PROBE_CACHE: dict = {}
 
 
 def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
-                    causal: bool, g: int = 1) -> bool:
+                    causal: bool, g: int = 1, window: int = 0) -> bool:
     """Shared one-time compile probe (forward and backward kernels): the
     block shapes depend only on (sq, sk, d, dtype, causal) — plus the GQA
-    group count ``g``, which changes the KV index maps and (backward) the
-    partial-output dtype — so a batch/head-reduced instance (q heads =
-    g, one KV head; tiny grid) proves lowering for the whole family."""
-    key = (sq, sk, d, jnp.dtype(dtype).name, causal, g)
+    group count ``g`` (it changes the KV index maps and, backward, the
+    partial-output dtype) and whether a sliding ``window`` is active (it
+    changes loop bounds/masking; the window LENGTH is loop arithmetic
+    with no lowering effect, so one probe covers every positive value) —
+    so a batch/head-reduced instance (q heads = g, one KV head; tiny
+    grid) proves lowering for the whole family."""
+    key = (sq, sk, d, jnp.dtype(dtype).name, causal, g, bool(window))
     ok = cache.get(key)
     if ok is None:
         import warnings
@@ -627,7 +669,7 @@ def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
         try:
             probe = jax.jit(functools.partial(
                 fn, q_off=jnp.int32(0), kv_off=jnp.int32(0),
-                causal=causal, interpret=False))
+                causal=causal, interpret=False, window=window))
             q = jax.ShapeDtypeStruct((1, sq, g, d), dtype)
             kv = jax.ShapeDtypeStruct((1, sk, 1, d), dtype)
             probe.lower(q, kv, kv, *extra_args).compile()
@@ -644,14 +686,16 @@ def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
     return ok
 
 
-def _pallas_compiles(sq, sk, d, dtype, causal: bool, g: int = 1) -> bool:
+def _pallas_compiles(sq, sk, d, dtype, causal: bool, g: int = 1,
+                     window: int = 0) -> bool:
     return _probe_compiles(_PROBE_CACHE, _pallas_block, (), "forward",
-                           sq, sk, d, dtype, causal, g)
+                           sq, sk, d, dtype, causal, g, window)
 
 
-def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str):
+def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str,
+                        window: int = 0):
     if impl == "jnp":
-        return _jnp_block(q, k, v, q_off, kv_off, causal)
+        return _jnp_block(q, k, v, q_off, kv_off, causal, window)
     if impl == "pallas":
         if not _eligible(q, k):
             raise ValueError(
@@ -660,22 +704,27 @@ def _block_fwd_dispatch(q, k, v, q_off, kv_off, causal: bool, impl: str):
                 f"block within the VMEM budget); got q{q.shape} "
                 f"k{k.shape} — use impl='auto' to fall back to jnp")
         return _pallas_block(q, k, v, q_off, kv_off, causal,
-                             interpret=not _on_tpu())
+                             interpret=not _on_tpu(), window=window)
     # auto
     if (_eligible(q, k) and _on_tpu()
             and _pallas_compiles(q.shape[1], k.shape[1], q.shape[3],
-                                 q.dtype, causal, _gqa_groups(q, k))):
-        return _pallas_block(q, k, v, q_off, kv_off, causal, interpret=False)
-    return _jnp_block(q, k, v, q_off, kv_off, causal)
+                                 q.dtype, causal, _gqa_groups(q, k),
+                                 window)):
+        return _pallas_block(q, k, v, q_off, kv_off, causal,
+                             interpret=False, window=window)
+    return _jnp_block(q, k, v, q_off, kv_off, causal, window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _block(q, k, v, q_off, kv_off, causal: bool, impl: str):
-    return _block_fwd_dispatch(q, k, v, q_off, kv_off, causal, impl)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _block(q, k, v, q_off, kv_off, causal: bool, impl: str,
+           window: int = 0):
+    return _block_fwd_dispatch(q, k, v, q_off, kv_off, causal, impl,
+                               window)
 
 
-def _block_fwd(q, k, v, q_off, kv_off, causal, impl):
-    out, lse = _block_fwd_dispatch(q, k, v, q_off, kv_off, causal, impl)
+def _block_fwd(q, k, v, q_off, kv_off, causal, impl, window=0):
+    out, lse = _block_fwd_dispatch(q, k, v, q_off, kv_off, causal, impl,
+                                   window)
     return (out, lse), (q, k, v, q_off, kv_off, out, lse)
 
 
@@ -688,12 +737,15 @@ _BWD_TILE_ABOVE = 512
 
 
 def _bwd_tile_math(qf, k_tile, v_tile, do, lse, delta, dlse, q_pos,
-                   kv_pos_tile, causal, scale):
+                   kv_pos_tile, causal, scale, window=0):
     """Gradient contributions of one KV tile (shared by the one-shot and
     tiled paths; flash backward: ds = p * (dp - delta + dlse))."""
     s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_tile) * scale
     if causal:
-        mask = (q_pos[:, None] >= kv_pos_tile[None, :])[None, :, None, :]
+        m2 = q_pos[:, None] >= kv_pos_tile[None, :]
+        if window:
+            m2 &= (q_pos[:, None] - kv_pos_tile[None, :]) < window
+        mask = m2[None, :, None, :]
         s = jnp.where(mask, s, NEG_BIG)
     p = jnp.exp(s - lse[..., None])          # = softmax over this block
     if causal:
@@ -714,7 +766,7 @@ def _zero_offsets(q_off):
     return np.zeros(jnp.shape(q_off), jax.dtypes.float0)
 
 
-def _block_bwd(causal, impl, res, cot):
+def _block_bwd(causal, impl, window, res, cot):
     """Flash-style backward by block recomputation (residuals: out + lse;
     the score matrix is rebuilt — never stored).  Dispatch mirrors the
     forward: the fused Pallas dq/dk/dv kernels on eligible TPU shapes
@@ -731,13 +783,14 @@ def _block_bwd(causal, impl, res, cot):
         use_kernel = (
             _bwd_eligible(q, k) and _on_tpu()
             and _pallas_bwd_compiles(q.shape[1], k.shape[1], q.shape[3],
-                                     q.dtype, causal, _gqa_groups(q, k)))
+                                     q.dtype, causal, _gqa_groups(q, k),
+                                     window))
     if use_kernel:
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1)                          # (b, sq, h)
         dd = delta - dlse.astype(jnp.float32)
         dq, dk, dv = _pallas_bwd(q, k, v, do, lse, dd, q_off, kv_off,
-                                 causal, interpret)
+                                 causal, interpret, window)
         zero_off = _zero_offsets(q_off)
         return dq, dk, dv, zero_off, zero_off
 
@@ -760,7 +813,7 @@ def _block_bwd(causal, impl, res, cot):
     kt = _KV_TILE
     if sk <= _BWD_TILE_ABOVE or sk % kt != 0:
         dq, dk, dv = _bwd_tile_math(qf, kf, vf, do, lse, delta, dlse,
-                                    q_pos, kv_pos, causal, scale)
+                                    q_pos, kv_pos, causal, scale, window)
     else:
         def body(j, carry):
             dq, dk, dv = carry
@@ -769,7 +822,7 @@ def _block_bwd(causal, impl, res, cot):
             kv_pos_t = jax.lax.dynamic_slice_in_dim(kv_pos, j * kt, kt, 0)
             dq_t, dk_t, dv_t = _bwd_tile_math(
                 qf, k_t, v_t, do, lse, delta, dlse, q_pos, kv_pos_t,
-                causal, scale)
+                causal, scale, window)
             dq = dq + dq_t
             dk = jax.lax.dynamic_update_slice_in_dim(dk, dk_t, j * kt, 1)
             dv = jax.lax.dynamic_update_slice_in_dim(dv, dv_t, j * kt, 1)
@@ -789,7 +842,8 @@ _block.defvjp(_block_fwd, _block_bwd)
 
 
 def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
-                          kv_offset=0, impl: str = "auto"
+                          kv_offset=0, impl: str = "auto",
+                          window: int = 0
                           ) -> Tuple[jax.Array, jax.Array]:
     """Normalized attention partials of ``q`` against one KV block.
 
@@ -806,7 +860,15 @@ def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
     ``(batch, seq_q, heads)`` in the compute dtype (f32, or f64 under x64
     on the jnp path).  ``impl``: ``"auto"`` (Pallas on
     eligible TPU shapes, else jnp), ``"pallas"`` (forced; interpreted off
-    TPU — for tests), ``"jnp"``."""
+    TPU — for tests), ``"jnp"``.
+
+    ``window > 0`` (requires ``causal``) restricts each query to its last
+    ``window`` positions, itself included — sliding-window/local
+    attention.  The kernels skip KV tiles on BOTH sides of the live band
+    (the causal diagonal above, the window frontier below), so compute
+    per q tile is O(window) regardless of sequence length; masking is
+    global-position-based, so windows span block boundaries under ring
+    attention exactly."""
     if impl not in ("auto", "pallas", "jnp"):
         raise ValueError(f"unknown impl {impl!r}")
     if k.shape != v.shape or q.shape[0] != k.shape[0] \
@@ -819,9 +881,15 @@ def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
             f"query heads ({q.shape[2]}) must be a multiple of KV heads "
             f"({k.shape[2]}) — grouped-query attention maps q head h to "
             f"KV head h // (h_q // h_kv)")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError(
+            "window > 0 requires causal=True (sliding-window attention "
+            "is defined over the causal mask)")
     q_off = jnp.asarray(q_offset, jnp.int32)
     kv_off = jnp.asarray(kv_offset, jnp.int32)
-    return _block(q, k, v, q_off, kv_off, causal, impl)
+    return _block(q, k, v, q_off, kv_off, causal, impl, window)
 
 
 def merge_partials(out_a, lse_a, out_b, lse_b):
@@ -861,7 +929,7 @@ def _kv_chunk_for(q, k) -> int:
 
 
 def flash_attention(q, k, v, *, causal: bool = False, impl: str = "auto",
-                    kv_chunk: int = 0):
+                    kv_chunk: int = 0, window: int = 0):
     """Single-device fused attention over the full local KV (the
     non-distributed entry; ``parallel.ring_attention`` composes the block
     primitive over a mesh axis instead).
@@ -894,7 +962,8 @@ def flash_attention(q, k, v, *, causal: bool = False, impl: str = "auto",
         chunk = 0
 
     if chunk == 0 or chunk == sk:
-        out, _ = flash_block_attention(q, k, v, causal=causal, impl=impl)
+        out, _ = flash_block_attention(q, k, v, causal=causal, impl=impl,
+                                       window=window)
         return out
 
     n_chunks = sk // chunk
@@ -907,7 +976,8 @@ def flash_attention(q, k, v, *, causal: bool = False, impl: str = "auto",
         k_c = jax.lax.dynamic_slice_in_dim(k, i * chunk, chunk, 1)
         v_c = jax.lax.dynamic_slice_in_dim(v, i * chunk, chunk, 1)
         o_b, lse_b = flash_block_attention(
-            q, k_c, v_c, causal=causal, kv_offset=i * chunk, impl=impl)
+            q, k_c, v_c, causal=causal, kv_offset=i * chunk, impl=impl,
+            window=window)
         out, lse = merge_partials(out, lse, o_b, lse_b)
         return (out, lse), None
 

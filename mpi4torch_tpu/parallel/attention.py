@@ -48,7 +48,7 @@ def dense_attention(q, k, v, causal: bool = False, q_offset=0, kv_offset=0):
 
 
 def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
-                   impl: str = "auto"):
+                   impl: str = "auto", window: int = 0):
     """Blockwise ring attention over the sequence axis (context parallel).
 
     Each rank holds one sequence block of q/k/v.  K/V blocks circulate the
@@ -92,7 +92,7 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
         owner = (my_rank - step) % size
         o_b, lse_b = flash_block_attention(
             q, k, v, causal=causal, q_offset=q_off,
-            kv_offset=owner * s_local, impl=impl)
+            kv_offset=owner * s_local, impl=impl, window=window)
         if out is None:
             out, lse = o_b, lse_b
         else:
@@ -104,7 +104,7 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
 
 
 def ulysses_attention(comm, q, k, v, causal: bool = False,
-                      impl: str = "auto"):
+                      impl: str = "auto", window: int = 0):
     """Ulysses sequence parallelism: all-to-all head<->sequence reshuffle.
 
     Each rank trades its sequence shard of ALL heads for the FULL sequence
@@ -143,5 +143,5 @@ def ulysses_attention(comm, q, k, v, causal: bool = False,
                              numelem=s_local)
 
     out = flash_attention(to_heads(q), to_heads(k), to_heads(v),
-                          causal=causal, impl=impl)
+                          causal=causal, impl=impl, window=window)
     return to_seq(out)

@@ -396,6 +396,27 @@ class TestCompiledKernelOnTPU:
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=1e-3, atol=1e-4)
 
+    def test_compiled_sliding_window_matches_jnp(self):
+        # Windowed masking + two-frontier tile-skip on hardware, fwd and
+        # bwd, window deliberately NOT a tile multiple.
+        q, k, v = qkv((2, 1024, 4, 128), dtype=jnp.float32, seed=22)
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(flash.flash_block_attention(
+                q, k, v, causal=True, window=200, impl=impl)[0] ** 2)
+
+        a, _ = flash.flash_block_attention(q, k, v, causal=True,
+                                           window=200, impl="pallas")
+        b, _ = flash.flash_block_attention(q, k, v, causal=True,
+                                           window=200, impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+        ga = jax.jit(jax.grad(loss("pallas"), argnums=(0, 1, 2)))(q, k, v)
+        gb = jax.jit(jax.grad(loss("jnp"), argnums=(0, 1, 2)))(q, k, v)
+        for x, y in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-3, atol=1e-4)
+
     def test_auto_selects_pallas_and_runs(self):
         # impl='auto' on hardware must engage the compiled kernel (probe
         # passes) and agree with the oracle — the flagship-model path.
@@ -567,6 +588,112 @@ class TestGQA:
         q, k, v = self._gqa_qkv(1, 16, 4, 3, 8, jnp.float64)
         with pytest.raises(ValueError, match="multiple of KV heads"):
             flash.flash_block_attention(q, k, v)
+
+
+def _dense_windowed(q, k, v, window, q_off=0, kv_off=0):
+    """Independent sliding-window oracle: explicit masked softmax."""
+    sq, sk = q.shape[1], k.shape[1]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    qp = q_off + np.arange(sq)[:, None]
+    kp = kv_off + np.arange(sk)[None, :]
+    mask = (qp >= kp) & (qp - kp < window)
+    s = jnp.where(mask[None, :, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask[None, :, None, :], p, 0.0)
+    return jnp.einsum("bqhk,bkhd->bqhd", p, v)
+
+
+class TestSlidingWindow:
+    """window > 0: each query attends its last `window` positions (itself
+    included).  Masking is global-position-based; the kernels tile-skip
+    BOTH frontiers (causal diagonal and window edge)."""
+
+    @pytest.mark.parametrize("window", [1, 3, 7, 100])
+    def test_jnp_matches_dense_oracle(self, window):
+        q, k, v = qkv((2, 16, 2, 8), seed=11)
+        out, _ = flash.flash_block_attention(q, k, v, causal=True,
+                                             window=window, impl="jnp")
+        want = _dense_windowed(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_offsets_shift_the_window(self):
+        # A window spanning a block boundary: the second block's queries
+        # must still see the first block's tail keys.
+        q, k, v = qkv((1, 8, 1, 4), seed=12)
+        q_hi = q[:, 4:]
+        out, _ = flash.flash_block_attention(
+            q_hi, k, v, causal=True, q_offset=4, window=6, impl="jnp")
+        want = _dense_windowed(q, k, v, 6)[:, 4:]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-10, atol=1e-12)
+
+    @pytest.mark.parametrize("window", [64, 100, 1000])
+    def test_pallas_interpret_matches_jnp(self, window):
+        # Window a tile multiple, unaligned, and larger than the whole
+        # sequence (=> plain causal).
+        q, k, v = qkv((1, 256, 2, 128), dtype=jnp.float32, seed=13)
+        a, la = flash.flash_block_attention(q, k, v, causal=True,
+                                            window=window, impl="pallas")
+        b, lb = flash.flash_block_attention(q, k, v, causal=True,
+                                            window=window, impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pallas_interpret_unaligned_offsets(self):
+        q, k, v = qkv((1, 256, 1, 128), dtype=jnp.float32, seed=14)
+        a, _ = flash.flash_block_attention(
+            q, k, v, causal=True, q_offset=300, kv_offset=170,
+            window=200, impl="pallas")
+        b, _ = flash.flash_block_attention(
+            q, k, v, causal=True, q_offset=300, kv_offset=170,
+            window=200, impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pallas_bwd_interpret_grads_match(self):
+        q, k, v = qkv((1, 256, 2, 128), dtype=jnp.float32, seed=15)
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(flash.flash_block_attention(
+                q, k, v, causal=True, window=100, impl=impl)[0] ** 2)
+
+        ga = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_window_with_gqa(self):
+        rng = np.random.default_rng(16)
+        q = jnp.asarray(rng.standard_normal((1, 256, 4, 128)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 256, 2, 128)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 256, 2, 128)), jnp.float32)
+        a, _ = flash.flash_block_attention(q, k, v, causal=True,
+                                           window=64, impl="pallas")
+        b, _ = flash.flash_block_attention(q, k, v, causal=True,
+                                           window=64, impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_chunked_windowed_matches_unchunked(self):
+        q, k, v = qkv((1, 64, 2, 8), seed=17)
+        a = flash.flash_attention(q, k, v, causal=True, window=20,
+                                  impl="jnp", kv_chunk=16)
+        b = flash.flash_attention(q, k, v, causal=True, window=20,
+                                  impl="jnp")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_validation(self):
+        q, k, v = qkv((1, 16, 1, 8))
+        with pytest.raises(ValueError, match="window must be >= 0"):
+            flash.flash_block_attention(q, k, v, causal=True, window=-1)
+        with pytest.raises(ValueError, match="requires causal"):
+            flash.flash_block_attention(q, k, v, window=8)
 
 
 class TestEligibility:

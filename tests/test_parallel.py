@@ -188,6 +188,38 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=1e-9, atol=1e-11)
 
+    @pytest.mark.parametrize("window", [3, 9])
+    def test_spmd_windowed_matches_oracle(self, window):
+        # Sliding windows span ring-block boundaries: rank r's early
+        # queries must still see rank r-1's tail keys.  Values AND grads
+        # against the full-sequence windowed flash oracle.
+        q, k, v = qkv()
+
+        def oracle_loss(q, k, v):
+            from mpi4torch_tpu.ops.flash import flash_attention
+            out = flash_attention(q, k, v, causal=True, window=window,
+                                  impl="jnp")
+            return jnp.sum(out ** 2), out
+
+        (_, ref), ref_grads = jax.value_and_grad(
+            oracle_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+
+        def fn(q, k, v):
+            r = comm.rank
+            out = ring_attention(comm, local_slice(q, r), local_slice(k, r),
+                                 local_slice(v, r), causal=True,
+                                 window=window)
+            return jnp.sum(out ** 2), out
+
+        (_, outs), grads = jax.value_and_grad(
+            lambda q, k, v: ((lambda l, o: (l.sum(), o))(*run(fn)(q, k, v))),
+            argnums=(0, 1, 2), has_aux=True)(q, k, v)
+        np.testing.assert_allclose(np.asarray(_assemble(outs)),
+                                   np.asarray(ref), rtol=1e-10, atol=1e-12)
+        for got, want in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-9, atol=1e-11)
+
     def test_eager_matches_dense(self):
         q, k, v = qkv()
         ref = np.asarray(dense_attention(q, k, v, causal=True))

@@ -161,6 +161,31 @@ def test_gqa_2d_mesh_matches_single_process(attn, dp, sp):
         new_params, ref_params)
 
 
+@pytest.mark.parametrize("attn,dp,sp", [("ring", 1, 8), ("ulysses", 2, 2)])
+def test_windowed_2d_mesh_matches_single_process(attn, dp, sp):
+    """Sliding-window attention (attn_window) through the distributed
+    step: windows span sequence-shard boundaries (s_local=2 at sp=8 with
+    window=5), so ring correctness depends on global-position masking."""
+    cfg = dataclasses.replace(CFG, attn_window=5)
+    params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                dtype=jnp.float64)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab)
+    ref_loss, ref_params = T.train_step(cfg, params, tokens)
+    # Windowing must actually change the model vs full attention.
+    full_loss, _ = T.train_step(CFG, params, tokens)
+    assert abs(float(ref_loss) - float(full_loss)) > 1e-9
+
+    loss, new_params = make_mesh_step(cfg, dp, sp, attn)(params, tokens)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-12, atol=1e-14)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-11),
+        new_params, ref_params)
+
+
 def test_gqa_bad_head_ratio_raises():
     with pytest.raises(ValueError, match="multiple of n_kv_heads"):
         dataclasses.replace(CFG, n_kv_heads=3)
