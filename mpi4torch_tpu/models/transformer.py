@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import MPI_SUM
-from ..ops.flash import flash_attention
+from ..ops.flash import flash_attention, flash_block_attention
 from ..parallel.attention import ring_attention, \
     ulysses_attention
 from ..parallel.dp import all_average_tree
@@ -134,6 +134,45 @@ def _layer_norm(x, p):
     return (x - mu) / jnp.sqrt(var + 1e-5) * p["scale"] + p["bias"]
 
 
+def _split_qkv(cfg: TransformerConfig, blk, y):
+    """Project ``y`` (b, s, d) through the fused qkv matrix and split into
+    ``q (b, s, h, hd)`` and ``k``/``v (b, s, kv_heads, hd)`` — the ONE
+    place the asymmetric GQA projection layout lives (forward, prefill
+    and decode all slice through here, so they cannot drift apart)."""
+    b, s = y.shape[0], y.shape[1]
+    h, h_kv = cfg.n_heads, cfg.kv_heads
+    hd = cfg.d_model // h
+    qkv = y @ blk["wqkv"]
+    q = qkv[..., :h * hd].reshape(b, s, h, hd)
+    k = qkv[..., h * hd:(h + h_kv) * hd].reshape(b, s, h_kv, hd)
+    v = qkv[..., (h + h_kv) * hd:].reshape(b, s, h_kv, hd)
+    return q, k, v
+
+
+def _ffn_residual(cfg: TransformerConfig, blk, x, comm_ep):
+    """Post-attention FFN (dense or MoE) with pre-LN and residual; shared
+    by the training forward and the decode path.  Returns ``(x, aux)``.
+
+    MoE routing note: capacity competition is over exactly the tokens in
+    ``x`` — a whole (batch x seq) call during training/prefill, one
+    position's batch during incremental decode.  When capacity binds,
+    the two can therefore drop different tokens; teacher-forcing
+    equivalence between :func:`forward` and :func:`decode_step` is exact
+    whenever capacity does not bind (see :func:`decode_step`)."""
+    b_s = x.shape[:-1]
+    d = x.shape[-1]
+    y = _layer_norm(x, blk["ln2"])
+    if cfg.n_experts > 0:
+        flat = y.reshape(-1, d)
+        if comm_ep is not None and comm_ep.size > 1:
+            ff, aux = moe_ffn(comm_ep, flat, blk["moe"], cfg.capacity)
+        else:
+            ff, aux = moe_ffn_dense(flat, blk["moe"], cfg.capacity)
+        return x + ff.reshape(*b_s, d), aux
+    return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"], \
+        jnp.zeros((), x.dtype)
+
+
 def _attention(q, k, v, comm_sp, attn: str, window: int = 0):
     if attn not in ("dense", "ring", "ulysses"):
         raise ValueError(f"unknown attention strategy {attn!r}")
@@ -188,30 +227,12 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     d = x.shape[-1]
     aux_total = jnp.zeros((), x.dtype)
 
-    h_kv = cfg.kv_heads
-    hd = cfg.d_model // h
-
     def block_fn(x, blk):
         y = _layer_norm(x, blk["ln1"])
-        qkv = y @ blk["wqkv"]
-        q = qkv[..., :h * hd]
-        k = qkv[..., h * hd:(h + h_kv) * hd]
-        v = qkv[..., (h + h_kv) * hd:]
-        split = lambda t, nh: t.reshape(b, s_local, nh, hd)
-        o = _attention(split(q, h), split(k, h_kv), split(v, h_kv),
-                       comm_sp, attn, cfg.attn_window)
+        q, k, v = _split_qkv(cfg, blk, y)
+        o = _attention(q, k, v, comm_sp, attn, cfg.attn_window)
         x = x + o.reshape(b, s_local, d) @ blk["wo"]
-        y = _layer_norm(x, blk["ln2"])
-        if cfg.n_experts > 0:
-            flat = y.reshape(b * s_local, d)
-            if comm_ep is not None and comm_ep.size > 1:
-                ff, aux = moe_ffn(comm_ep, flat, blk["moe"], cfg.capacity)
-            else:
-                ff, aux = moe_ffn_dense(flat, blk["moe"], cfg.capacity)
-            x = x + ff.reshape(b, s_local, d)
-        else:
-            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
-            aux = jnp.zeros((), x.dtype)
+        x, aux = _ffn_residual(cfg, blk, x, comm_ep)
         return x, aux
 
     if cfg.remat:
@@ -224,6 +245,129 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     if return_aux:
         return logits, aux_total
     return logits
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
+    """Per-layer K/V cache for incremental decoding, shaped
+    ``(batch, max_seq, kv_heads, head_dim)`` — under GQA the cache holds
+    only the KV heads (the whole point: at ``n_kv_heads = n_heads/8`` the
+    decode-time cache is 8x smaller, which is the HBM-resident state that
+    bounds TPU batch size during serving)."""
+    hd = cfg.d_model // cfg.n_heads
+    shape = (batch, cfg.max_seq, cfg.kv_heads, hd)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
+    """One incremental decode step: logits for ``tokens`` (batch,) at
+    position ``pos`` (scalar, may be traced), updating the KV cache.
+
+    Returns ``(logits (batch, vocab), new_cache)``.  Attention runs the
+    query against the full cache buffer with position-based masking
+    (causal + ``cfg.attn_window``): slots beyond ``pos`` are masked as
+    future, so the static ``max_seq`` buffer needs no length bookkeeping
+    — the XLA-native shape discipline (no dynamic shapes, one compiled
+    program for every step).  Jit-compatible: drive it under
+    ``lax.scan`` (:func:`generate`).
+
+    Teacher-forcing equivalence: feeding the training sequence token by
+    token reproduces :func:`forward`'s logits exactly
+    (tests/test_transformer.py TestDecoding) — with one carve-out: MoE
+    capacity competition is per *call* (see :func:`_ffn_residual`), so
+    with ``n_experts > 0`` the equivalence holds only while capacity
+    does not bind (decode routes ``batch`` tokens per step vs a whole
+    batch x seq during training)."""
+    b = tokens.shape[0]
+    try:
+        # Concrete positions are checked eagerly: past max_seq the
+        # dynamic slice/update would CLAMP — reusing the last positional
+        # embedding and overwriting the last cache slot with plausible
+        # but wrong results (the same hazard forward() guards).  Traced
+        # positions (inside scan/jit) can't be checked here; generate()
+        # enforces the bound before tracing.
+        if int(pos) >= cfg.max_seq:
+            raise ValueError(
+                f"decode position {int(pos)} out of range: cfg.max_seq "
+                f"is {cfg.max_seq}")
+    except jax.errors.ConcretizationTypeError:
+        pass
+    pos = jnp.asarray(pos, jnp.int32)
+
+    x = params["embed"][tokens] + \
+        jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)[0]
+    new_cache = []
+    for blk, c in zip(params["blocks"], cache):
+        y = _layer_norm(x, blk["ln1"])
+        q, k_new, v_new = _split_qkv(cfg, blk, y[:, None, :])
+        ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, pos, 1)
+        cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v_new, pos, 1)
+        new_cache.append({"k": ck, "v": cv})
+        o, _ = flash_block_attention(
+            q, ck, cv, causal=True, q_offset=pos, kv_offset=0,
+            window=cfg.attn_window, impl="jnp")
+        x = x + o.reshape(b, cfg.d_model) @ blk["wo"]
+        x, _ = _ffn_residual(cfg, blk, x, None)
+    x = _layer_norm(x, params["ln_f"])
+    return x @ params["unembed"], new_cache
+
+
+def prefill(cfg: TransformerConfig, params, cache, prompt):
+    """Populate the KV cache from a whole prompt in ONE batched pass (the
+    training forward's compute shape — MXU-sized matmuls over the full
+    prompt — rather than prompt_len sequential single-token steps) and
+    return ``(last_logits (batch, vocab), new_cache)``."""
+    b, p_len = prompt.shape
+    x = params["embed"][prompt] + params["pos"][None, :p_len]
+    new_cache = []
+    for blk, c in zip(params["blocks"], cache):
+        y = _layer_norm(x, blk["ln1"])
+        q, k, v = _split_qkv(cfg, blk, y)
+        ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, 1)
+        cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0, 1)
+        new_cache.append({"k": ck, "v": cv})
+        o = flash_attention(q, k, v, causal=True, window=cfg.attn_window)
+        x = x + o.reshape(b, p_len, cfg.d_model) @ blk["wo"]
+        x, _ = _ffn_residual(cfg, blk, x, None)
+    x = _layer_norm(x, params["ln_f"])
+    return x[:, -1] @ params["unembed"], new_cache
+
+
+def generate(cfg: TransformerConfig, params, prompt, n_new: int,
+             dtype=jnp.float32):
+    """Greedy decoding: prefill the cache from ``prompt``
+    (batch, prompt_len) in one batched pass, then emit ``n_new`` tokens
+    incrementally.
+
+    Generation is a single compiled ``lax.scan`` over :func:`decode_step`
+    (each argmax fed back in), so generation length never retriggers
+    compilation.  Returns (batch, prompt_len + n_new) tokens."""
+    b, p_len = prompt.shape
+    if p_len + n_new > cfg.max_seq:
+        raise ValueError(
+            f"prompt {p_len} + n_new {n_new} exceeds max_seq "
+            f"{cfg.max_seq}")
+    if n_new == 0:
+        return prompt
+
+    logits, cache = prefill(cfg, params, init_kv_cache(cfg, b, dtype),
+                            prompt)
+    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+    # Each step feeds the token at position i and emits position i+1's
+    # argmax; feeding stops one short of the final position — the last
+    # emitted token needs no decode of its own.
+    def step(carry, i):
+        cache, tok = carry
+        logits, cache = decode_step(cfg, params, cache, tok, i)
+        nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return (cache, nxt), nxt
+
+    (_, _), rest = jax.lax.scan(
+        step, (cache, first),
+        jnp.arange(p_len, p_len + n_new - 1, dtype=jnp.int32))
+    gen = jnp.concatenate([first[None], rest], axis=0)   # (n_new, b)
+    return jnp.concatenate([prompt, gen.T], axis=1)
 
 
 def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,

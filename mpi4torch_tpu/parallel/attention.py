@@ -75,16 +75,29 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
     my_rank = jnp.asarray(comm.rank)
     q_off = my_rank * s_local
 
+    # Sliding windows bound how far back any query looks: rank r's
+    # earliest visible key is r*s_local - window + 1, i.e. at most
+    # ceil((window-1)/s_local) blocks behind its own — every later ring
+    # rotation would deliver a fully-masked block (merged as a neutral
+    # lse=NEG_BIG partial, but still one permute + kernel launch per
+    # layer).  The bound is position arithmetic only, identical on every
+    # rank, so cutting the loop is SPMD-symmetric: distributed windowed
+    # attention costs O(window/s_local) rotations, not O(size).
+    if causal and window:
+        n_steps = min(size, -(-(window - 1) // s_local) + 1)
+    else:
+        n_steps = size
+
     out = None
     lse = None
-    for step in range(size):
+    for step in range(n_steps):
         # Issue the NEXT block's ring hop before this block's compute:
         # the permute reads the same K/V the compute does (no data
         # dependence between them), so putting the collective first in
         # program order lets XLA's async collective-permute-start/done
         # pair bracket the block matmuls — communication hides behind
         # compute instead of serializing after it.
-        if step + 1 < size:
+        if step + 1 < n_steps:
             k_next = ring_shift(comm, k, 1, tag + 2 * step)
             v_next = ring_shift(comm, v, 1, tag + 2 * step + 1)
         # After `step` +1-shifts the local K/V block originated on rank
@@ -97,7 +110,7 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
             out, lse = o_b, lse_b
         else:
             out, lse = merge_partials(out, lse, o_b, lse_b)
-        if step + 1 < size:
+        if step + 1 < n_steps:
             k, v = k_next, v_next
 
     return out

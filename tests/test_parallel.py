@@ -220,6 +220,22 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=1e-9, atol=1e-11)
 
+    def test_windowed_ring_cuts_rotations(self):
+        # window=3 at s_local=4 reaches at most 1 block back: the lowered
+        # program must contain exactly ceil(2/4)+1 = 2 live ring steps ->
+        # 2 collective_permutes (k and v, one hop each), not the full
+        # ring's 2*(NR-1) = 6.
+        q, k, v = qkv()
+
+        def fn(q, k, v):
+            r = comm.rank
+            return ring_attention(comm, local_slice(q, r), local_slice(k, r),
+                                  local_slice(v, r), causal=True, window=3)
+
+        hlo = jax.jit(run(fn)).lower(q, k, v).as_text()
+        assert hlo.count("collective_permute") == 2, \
+            hlo.count("collective_permute")
+
     def test_eager_matches_dense(self):
         q, k, v = qkv()
         ref = np.asarray(dense_attention(q, k, v, causal=True))
