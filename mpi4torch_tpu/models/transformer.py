@@ -286,7 +286,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
         # but wrong results (the same hazard forward() guards).  Traced
         # positions (inside scan/jit) can't be checked here; generate()
         # enforces the bound before tracing.
-        if int(pos) >= cfg.max_seq:
+        if not 0 <= int(pos) < cfg.max_seq:
             raise ValueError(
                 f"decode position {int(pos)} out of range: cfg.max_seq "
                 f"is {cfg.max_seq}")
@@ -334,14 +334,17 @@ def prefill(cfg: TransformerConfig, params, cache, prompt):
 
 
 def generate(cfg: TransformerConfig, params, prompt, n_new: int,
-             dtype=jnp.float32):
+             dtype=None):
     """Greedy decoding: prefill the cache from ``prompt``
     (batch, prompt_len) in one batched pass, then emit ``n_new`` tokens
     incrementally.
 
-    Generation is a single compiled ``lax.scan`` over :func:`decode_step`
-    (each argmax fed back in), so generation length never retriggers
-    compilation.  Returns (batch, prompt_len + n_new) tokens."""
+    Generation is a single ``lax.scan`` over :func:`decode_step` (each
+    argmax fed back in): every step within a generation shares one
+    compiled step program (a distinct ``n_new`` still traces a new scan
+    — fix the serving-side token budget to avoid recompiles).  The cache
+    dtype follows the parameters unless ``dtype`` overrides it.  Returns
+    (batch, prompt_len + n_new) tokens."""
     b, p_len = prompt.shape
     if p_len + n_new > cfg.max_seq:
         raise ValueError(
@@ -349,6 +352,8 @@ def generate(cfg: TransformerConfig, params, prompt, n_new: int,
             f"{cfg.max_seq}")
     if n_new == 0:
         return prompt
+    if dtype is None:
+        dtype = params["embed"].dtype
 
     logits, cache = prefill(cfg, params, init_kv_cache(cfg, b, dtype),
                             prompt)
