@@ -202,6 +202,16 @@ def _bench_flash(on_tpu: bool, peak: float):
     step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
     dt = _timeit(step, q, k, v, iters=iters)
 
+    def kernel_flags(window=0):
+        fwd = bool(on_tpu and flash._eligible(q, k)
+                   and flash._pallas_compiles(s, s, d, dtype, True,
+                                              window=window))
+        bwd = bool(on_tpu and flash._bwd_eligible(q, k)
+                   and flash._pallas_bwd_compiles(s, s, d, dtype, True,
+                                                  window=window))
+        return fwd, bwd
+
+
     # Sliding-window variant at the same shape: the two-frontier tile
     # skip should make cost ~O(window/seq) of full causal — report the
     # measured ratio so the claim is a number, not a comment.  Guarded
@@ -222,15 +232,9 @@ def _bench_flash(on_tpu: bool, peak: float):
             # the pallas flags first — a windowed-probe failure falls
             # back to jnp and balloons the time for a different reason.
             "time_ratio_vs_full": round(dt_w / dt, 4),
-            "pallas_fwd": bool(
-                on_tpu and flash._eligible(q, k)
-                and flash._pallas_compiles(s, s, d, dtype, True,
-                                           window=window)),
-            "pallas_bwd": bool(
-                on_tpu and flash._bwd_eligible(q, k)
-                and flash._pallas_bwd_compiles(s, s, d, dtype, True,
-                                               window=window)),
         }
+        windowed["pallas_fwd"], windowed["pallas_bwd"] = \
+            kernel_flags(window)
     except BaseException as e:  # noqa: BLE001 — sub-measurement guard
         windowed = {"window": window,
                     "error": f"{type(e).__name__}: {str(e)[:300]}"}
@@ -246,12 +250,7 @@ def _bench_flash(on_tpu: bool, peak: float):
     # backward is ~2/3 of the FLOPs and gates independently (its own
     # eligibility + compile probe), so a single flag would mislabel a
     # jnp-backward run as fully fused.
-    fwd_kernel = bool(
-        on_tpu and flash._eligible(q, k)
-        and flash._pallas_compiles(s, s, d, dtype, True))
-    bwd_kernel = bool(
-        on_tpu and flash._bwd_eligible(q, k)
-        and flash._pallas_bwd_compiles(s, s, d, dtype, True))
+    fwd_kernel, bwd_kernel = kernel_flags()
     return {
         "tflops": round(achieved / 1e12, 3),
         "mfu": round(achieved / peak, 4),

@@ -57,6 +57,8 @@ class TransformerConfig:
     max_seq: int
     n_kv_heads: int = 0
     attn_window: int = 0
+    rope: bool = False
+    rope_theta: float = 10000.0
     n_experts: int = 0
     capacity: int = 0
     aux_coef: float = 0.01
@@ -81,6 +83,10 @@ class TransformerConfig:
             raise ValueError(
                 f"attn_window must be >= 0 (0 = full causal attention), "
                 f"got {self.attn_window}")
+        if self.rope and (self.d_model // self.n_heads) % 2 != 0:
+            raise ValueError(
+                f"rope requires an even head_dim, got "
+                f"{self.d_model // self.n_heads}")
 
     @property
     def kv_heads(self) -> int:
@@ -99,12 +105,22 @@ def init_transformer(key, cfg: TransformerConfig,
     keys = iter(jax.random.split(key, 4 + 7 * n_layers))
     params: Dict[str, Any] = {
         "embed": jax.random.normal(next(keys), (vocab, d_model), dtype) * 0.02,
-        "pos": jax.random.normal(next(keys), (max_seq, d_model), dtype) * 0.02,
-        "ln_f": {"scale": jnp.ones((d_model,), dtype),
-                 "bias": jnp.zeros((d_model,), dtype)},
-        "unembed": dense(next(keys), d_model, vocab),
         "blocks": [],
     }
+    # The pos key is drawn UNCONDITIONALLY at its historical position in
+    # the stream (and discarded under rope): making the draw conditional
+    # would shift every later key and silently change all existing
+    # non-rope initializations for the same seed.
+    pos_key = next(keys)
+    if not cfg.rope:
+        # Learned absolute positions; under rope the encoding is applied
+        # rotationally to q/k instead (no table, no max_seq cap on the
+        # encoding itself).
+        params["pos"] = jax.random.normal(
+            pos_key, (max_seq, d_model), dtype) * 0.02
+    params["ln_f"] = {"scale": jnp.ones((d_model,), dtype),
+                      "bias": jnp.zeros((d_model,), dtype)}
+    params["unembed"] = dense(next(keys), d_model, vocab)
     for _ in range(n_layers):
         # Fused projection: h q-heads plus 2*h_kv KV heads (= 3*d_model
         # for plain MHA; smaller under GQA).
@@ -134,7 +150,35 @@ def _layer_norm(x, p):
     return (x - mu) / jnp.sqrt(var + 1e-5) * p["scale"] + p["bias"]
 
 
-def _split_qkv(cfg: TransformerConfig, blk, y):
+def _rope_rotate(cfg: TransformerConfig, x, positions):
+    """Rotary position embedding (half-split convention): rotate each
+    (x[i], x[i+hd/2]) pair of head-dim channels by ``pos * theta^(-2i/hd)``.
+    Attention scores of two rotated vectors depend only on their position
+    DIFFERENCE — the relative encoding that lets trained models attend
+    beyond any absolute position table (the long-context default; the
+    learned absolute table hard-caps at max_seq).  ``positions`` (s,) may
+    be traced (rank-symbolic global offsets under SPMD), so the sharded
+    shards of one sequence rotate consistently and ring/Ulysses need no
+    special handling: q/k are rotated BEFORE any transport."""
+    hd = x.shape[-1]
+    half = hd // 2
+    ct = _compute_dtype_rope(x)
+    inv = cfg.rope_theta ** (-jnp.arange(half, dtype=ct) * 2.0 / hd)
+    ang = positions.astype(ct)[:, None] * inv[None, :]        # (s, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(ct), x[..., half:].astype(ct)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def _compute_dtype_rope(x):
+    # Angles at least f32 (bf16 positions would alias long-context
+    # phases); f64 params keep f64 so oracle tests compare at 1e-12.
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
+def _split_qkv(cfg: TransformerConfig, blk, y, positions=None):
     """Project ``y`` (b, s, d) through the fused qkv matrix and split into
     ``q (b, s, h, hd)`` and ``k``/``v (b, s, kv_heads, hd)`` — the ONE
     place the asymmetric GQA projection layout lives (forward, prefill
@@ -146,6 +190,11 @@ def _split_qkv(cfg: TransformerConfig, blk, y):
     q = qkv[..., :h * hd].reshape(b, s, h, hd)
     k = qkv[..., h * hd:(h + h_kv) * hd].reshape(b, s, h_kv, hd)
     v = qkv[..., (h + h_kv) * hd:].reshape(b, s, h_kv, hd)
+    if cfg.rope:
+        if positions is None:
+            raise ValueError("cfg.rope requires the caller's positions")
+        q = _rope_rotate(cfg, q, positions)
+        k = _rope_rotate(cfg, k, positions)
     return q, k, v
 
 
@@ -211,9 +260,13 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     b, s_local = tokens.shape
     h = cfg.n_heads
     if comm_sp is not None and comm_sp.size > 1:
-        if comm_sp.size * s_local > cfg.max_seq:
-            # Without this, dynamic_slice would clamp the high ranks' start
-            # offsets and silently reuse the last positional block.
+        if not cfg.rope and comm_sp.size * s_local > cfg.max_seq:
+            # Without this, the positional-table dynamic_slice would
+            # clamp the high ranks' start offsets and silently reuse the
+            # last positional block.  Under rope there is no table and
+            # no cap: positions are computed directly, and training past
+            # max_seq is exactly the beyond-table long-context case the
+            # relative encoding exists for.
             raise ValueError(
                 f"global sequence {comm_sp.size * s_local} (sp="
                 f"{comm_sp.size} x s_local={s_local}) exceeds cfg.max_seq "
@@ -221,15 +274,17 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
         offset = jnp.asarray(comm_sp.rank) * s_local
     else:
         offset = 0
-    pos = jax.lax.dynamic_slice_in_dim(params["pos"], offset, s_local, 0)
-
-    x = params["embed"][tokens] + pos[None]
+    positions = offset + jnp.arange(s_local, dtype=jnp.int32)
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos"], offset, s_local, 0)[None]
     d = x.shape[-1]
     aux_total = jnp.zeros((), x.dtype)
 
     def block_fn(x, blk):
         y = _layer_norm(x, blk["ln1"])
-        q, k, v = _split_qkv(cfg, blk, y)
+        q, k, v = _split_qkv(cfg, blk, y, positions)
         o = _attention(q, k, v, comm_sp, attn, cfg.attn_window)
         x = x + o.reshape(b, s_local, d) @ blk["wo"]
         x, aux = _ffn_residual(cfg, blk, x, comm_ep)
@@ -294,12 +349,13 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
         pass
     pos = jnp.asarray(pos, jnp.int32)
 
-    x = params["embed"][tokens] + \
-        jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)[0]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)[0]
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
         y = _layer_norm(x, blk["ln1"])
-        q, k_new, v_new = _split_qkv(cfg, blk, y[:, None, :])
+        q, k_new, v_new = _split_qkv(cfg, blk, y[:, None, :], pos[None])
         ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, pos, 1)
         cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v_new, pos, 1)
         new_cache.append({"k": ck, "v": cv})
@@ -318,11 +374,14 @@ def prefill(cfg: TransformerConfig, params, cache, prompt):
     prompt — rather than prompt_len sequential single-token steps) and
     return ``(last_logits (batch, vocab), new_cache)``."""
     b, p_len = prompt.shape
-    x = params["embed"][prompt] + params["pos"][None, :p_len]
+    x = params["embed"][prompt]
+    if not cfg.rope:
+        x = x + params["pos"][None, :p_len]
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
         y = _layer_norm(x, blk["ln1"])
-        q, k, v = _split_qkv(cfg, blk, y)
+        q, k, v = _split_qkv(cfg, blk, y,
+                             jnp.arange(p_len, dtype=jnp.int32))
         ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, 1)
         cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0, 1)
         new_cache.append({"k": ck, "v": cv})

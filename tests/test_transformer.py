@@ -186,6 +186,83 @@ def test_windowed_2d_mesh_matches_single_process(attn, dp, sp):
         new_params, ref_params)
 
 
+class TestRoPE:
+    """Rotary position embeddings: relative encoding applied to q/k
+    before any transport, so distributed strategies need no special
+    handling and decode positions extend past any learned table."""
+
+    def test_shift_invariance(self):
+        # Rope'd attention depends only on position DIFFERENCES: shifting
+        # every position (and the causal offsets) by a constant must not
+        # change the output at all.
+        cfg = dataclasses.replace(CFG, rope=True)
+        rng = np.random.default_rng(31)
+        q = jnp.asarray(rng.standard_normal((1, 8, 2, 4)))
+        k = jnp.asarray(rng.standard_normal((1, 8, 2, 4)))
+        v = jnp.asarray(rng.standard_normal((1, 8, 2, 4)))
+        from mpi4torch_tpu.ops.flash import flash_block_attention
+        pos0 = jnp.arange(8, dtype=jnp.int32)
+
+        def attend(shift):
+            qr = T._rope_rotate(cfg, q, pos0 + shift)
+            kr = T._rope_rotate(cfg, k, pos0 + shift)
+            out, _ = flash_block_attention(
+                qr, kr, v, causal=True, q_offset=shift, kv_offset=shift,
+                impl="jnp")
+            return out
+
+        np.testing.assert_allclose(np.asarray(attend(0)),
+                                   np.asarray(attend(1000)),
+                                   rtol=1e-9, atol=1e-11)
+
+    def test_no_learned_table(self):
+        cfg = dataclasses.replace(CFG, rope=True)
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        assert "pos" not in params
+
+    @pytest.mark.parametrize("attn,dp,sp", [("ring", 2, 4),
+                                            ("ulysses", 4, 2)])
+    def test_rope_2d_mesh_matches_single_process(self, attn, dp, sp):
+        cfg = dataclasses.replace(CFG, rope=True, n_kv_heads=2)
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab)
+        ref_loss, ref_params = T.train_step(cfg, params, tokens)
+
+        loss, new_params = make_mesh_step(cfg, dp, sp, attn)(params,
+                                                             tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-12, atol=1e-14)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-11),
+            new_params, ref_params)
+
+    def test_teacher_forced_decode_matches_forward(self):
+        cfg = dataclasses.replace(CFG, rope=True, attn_window=5)
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab)
+        want = T.forward(cfg, params, tokens)
+        cache = T.init_kv_cache(cfg, B, jnp.float64)
+        got = []
+        for i in range(S):
+            logits, cache = T.decode_step(cfg, params, cache,
+                                          tokens[:, i], i)
+            got.append(logits)
+        np.testing.assert_allclose(np.asarray(jnp.stack(got, axis=1)),
+                                   np.asarray(want),
+                                   rtol=1e-9, atol=1e-11)
+
+    def test_odd_head_dim_raises(self):
+        with pytest.raises(ValueError, match="even head_dim"):
+            T.TransformerConfig(vocab=8, d_model=24, n_heads=8,
+                                n_layers=1, d_ff=8, max_seq=8, rope=True)
+
+
 def test_gqa_bad_head_ratio_raises():
     with pytest.raises(ValueError, match="multiple of n_kv_heads"):
         dataclasses.replace(CFG, n_kv_heads=3)
