@@ -392,43 +392,72 @@ def prefill(cfg: TransformerConfig, params, cache, prompt):
     return x[:, -1] @ params["unembed"], new_cache
 
 
+def _select_token(logits, key, temperature: float, top_k: int, dtype):
+    """One decoding choice from (batch, vocab) logits: greedy when
+    ``temperature == 0``, else categorical sampling at the given
+    temperature, optionally restricted to the ``top_k`` highest logits
+    (0 = no restriction)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1).astype(dtype)
+
+
 def generate(cfg: TransformerConfig, params, prompt, n_new: int,
-             dtype=None):
-    """Greedy decoding: prefill the cache from ``prompt``
+             dtype=None, temperature: float = 0.0, top_k: int = 0,
+             key=None):
+    """Autoregressive decoding: prefill the cache from ``prompt``
     (batch, prompt_len) in one batched pass, then emit ``n_new`` tokens
     incrementally.
 
-    Generation is a single ``lax.scan`` over :func:`decode_step` (each
-    argmax fed back in): every step within a generation shares one
-    compiled step program (a distinct ``n_new`` still traces a new scan
-    — fix the serving-side token budget to avoid recompiles).  The cache
-    dtype follows the parameters unless ``dtype`` overrides it.  Returns
+    ``temperature == 0`` (default) is greedy argmax; ``temperature > 0``
+    samples categorically (requires ``key``), optionally from only the
+    ``top_k`` highest-logit tokens.  Generation is a single ``lax.scan``
+    over :func:`decode_step` (each emitted token fed back in): every
+    step within a generation shares one compiled step program (a
+    distinct ``n_new`` still traces a new scan — fix the serving-side
+    token budget to avoid recompiles).  The cache dtype follows the
+    parameters unless ``dtype`` overrides it.  Returns
     (batch, prompt_len + n_new) tokens."""
     b, p_len = prompt.shape
     if p_len + n_new > cfg.max_seq:
         raise ValueError(
             f"prompt {p_len} + n_new {n_new} exceeds max_seq "
             f"{cfg.max_seq}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or top_k > cfg.vocab:
+        raise ValueError(
+            f"top_k must be in [0, vocab={cfg.vocab}], got {top_k}")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature > 0 requires a PRNG `key`")
     if n_new == 0:
         return prompt
     if dtype is None:
         dtype = params["embed"].dtype
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused on the greedy path
 
     logits, cache = prefill(cfg, params, init_kv_cache(cfg, b, dtype),
                             prompt)
-    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    key, sub = jax.random.split(key)
+    first = _select_token(logits, sub, temperature, top_k, prompt.dtype)
 
     # Each step feeds the token at position i and emits position i+1's
-    # argmax; feeding stops one short of the final position — the last
+    # choice; feeding stops one short of the final position — the last
     # emitted token needs no decode of its own.
     def step(carry, i):
-        cache, tok = carry
+        cache, tok, key = carry
         logits, cache = decode_step(cfg, params, cache, tok, i)
-        nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return (cache, nxt), nxt
+        key, sub = jax.random.split(key)
+        nxt = _select_token(logits, sub, temperature, top_k, prompt.dtype)
+        return (cache, nxt, key), nxt
 
-    (_, _), rest = jax.lax.scan(
-        step, (cache, first),
+    (_, _, _), rest = jax.lax.scan(
+        step, (cache, first, key),
         jnp.arange(p_len, p_len + n_new - 1, dtype=jnp.int32))
     gen = jnp.concatenate([first[None], rest], axis=0)   # (n_new, b)
     return jnp.concatenate([prompt, gen.T], axis=1)

@@ -330,6 +330,33 @@ class TestDecoding:
         with pytest.raises(ValueError, match="exceeds max_seq"):
             T.generate(CFG, params, prompt, n_new=1)
 
+    def test_sampled_generation(self):
+        cfg = CFG
+        params = T.init_transformer(jax.random.PRNGKey(2), cfg,
+                                    dtype=jnp.float64)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0,
+                                    cfg.vocab)
+        greedy = T.generate(cfg, params, prompt, n_new=6)
+        # Vanishing temperature concentrates the categorical on the
+        # argmax: must reproduce greedy exactly.
+        cold = T.generate(cfg, params, prompt, n_new=6, temperature=1e-6,
+                          key=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(cold), np.asarray(greedy))
+        # Same key -> same sample; top_k=1 is greedy regardless of temp.
+        s1 = T.generate(cfg, params, prompt, n_new=6, temperature=2.0,
+                        key=jax.random.PRNGKey(7))
+        s2 = T.generate(cfg, params, prompt, n_new=6, temperature=2.0,
+                        key=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        k1 = T.generate(cfg, params, prompt, n_new=6, temperature=5.0,
+                        top_k=1, key=jax.random.PRNGKey(9))
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+        assert bool(jnp.all(s1 >= 0)) and bool(jnp.all(s1 < cfg.vocab))
+        with pytest.raises(ValueError, match="requires a PRNG"):
+            T.generate(cfg, params, prompt, n_new=2, temperature=1.0)
+        with pytest.raises(ValueError, match="top_k"):
+            T.generate(cfg, params, prompt, n_new=2, top_k=cfg.vocab + 1)
+
     def test_decode_step_concrete_overflow_raises(self):
         # Past max_seq the dynamic slice would CLAMP (silently reusing
         # the last positional row and cache slot); concrete positions
