@@ -59,6 +59,8 @@ class TransformerConfig:
     attn_window: int = 0
     rope: bool = False
     rope_theta: float = 10000.0
+    norm: str = "layernorm"
+    ffn: str = "gelu"
     n_experts: int = 0
     capacity: int = 0
     aux_coef: float = 0.01
@@ -87,6 +89,14 @@ class TransformerConfig:
             raise ValueError(
                 f"rope requires an even head_dim, got "
                 f"{self.d_model // self.n_heads}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown norm {self.norm!r}")
+        if self.ffn not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown ffn {self.ffn!r}")
+        if self.ffn == "swiglu" and self.n_experts > 0:
+            raise ValueError(
+                "ffn='swiglu' applies to the dense FFN; the MoE experts "
+                "(n_experts > 0) keep their own gelu expert MLPs")
 
     @property
     def kv_heads(self) -> int:
@@ -101,6 +111,12 @@ def init_transformer(key, cfg: TransformerConfig,
     def dense(key, m, n):
         return jax.random.normal(key, (m, n), dtype) / jnp.sqrt(
             jnp.asarray(m, dtype))
+
+    def norm_p():
+        p = {"scale": jnp.ones((d_model,), dtype)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((d_model,), dtype)
+        return p
 
     keys = iter(jax.random.split(key, 4 + 7 * n_layers))
     params: Dict[str, Any] = {
@@ -118,25 +134,26 @@ def init_transformer(key, cfg: TransformerConfig,
         # encoding itself).
         params["pos"] = jax.random.normal(
             pos_key, (max_seq, d_model), dtype) * 0.02
-    params["ln_f"] = {"scale": jnp.ones((d_model,), dtype),
-                      "bias": jnp.zeros((d_model,), dtype)}
+    params["ln_f"] = norm_p()
     params["unembed"] = dense(next(keys), d_model, vocab)
     for _ in range(n_layers):
         # Fused projection: h q-heads plus 2*h_kv KV heads (= 3*d_model
         # for plain MHA; smaller under GQA).
         hd = d_model // cfg.n_heads
         blk = {
-            "ln1": {"scale": jnp.ones((d_model,), dtype),
-                    "bias": jnp.zeros((d_model,), dtype)},
+            "ln1": norm_p(),
             "wqkv": dense(next(keys), d_model,
                           d_model + 2 * cfg.kv_heads * hd),
             "wo": dense(next(keys), d_model, d_model),
-            "ln2": {"scale": jnp.ones((d_model,), dtype),
-                    "bias": jnp.zeros((d_model,), dtype)},
+            "ln2": norm_p(),
         }
         if cfg.n_experts > 0:
             blk["moe"] = init_moe(next(keys), cfg.n_experts, d_model, d_ff,
                                   dtype)
+        elif cfg.ffn == "swiglu":
+            # Gate and up projections fused into one (d, 2*d_ff) matmul.
+            blk["w1"] = dense(next(keys), d_model, 2 * d_ff)
+            blk["w2"] = dense(next(keys), d_ff, d_model)
         else:
             blk["w1"] = dense(next(keys), d_model, d_ff)
             blk["w2"] = dense(next(keys), d_ff, d_model)
@@ -148,6 +165,17 @@ def _layer_norm(x, p):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return (x - mu) / jnp.sqrt(var + 1e-5) * p["scale"] + p["bias"]
+
+
+def _rms_norm(x, p):
+    # No centering, no bias: normalize by the root-mean-square alone —
+    # one fewer reduction and a smaller param set than LayerNorm.
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x / jnp.sqrt(ms + 1e-5) * p["scale"]
+
+
+def _norm(cfg: TransformerConfig, x, p):
+    return _rms_norm(x, p) if cfg.norm == "rmsnorm" else _layer_norm(x, p)
 
 
 def _rope_rotate(cfg: TransformerConfig, x, positions):
@@ -210,7 +238,7 @@ def _ffn_residual(cfg: TransformerConfig, blk, x, comm_ep):
     whenever capacity does not bind (see :func:`decode_step`)."""
     b_s = x.shape[:-1]
     d = x.shape[-1]
-    y = _layer_norm(x, blk["ln2"])
+    y = _norm(cfg, x, blk["ln2"])
     if cfg.n_experts > 0:
         flat = y.reshape(-1, d)
         if comm_ep is not None and comm_ep.size > 1:
@@ -218,6 +246,11 @@ def _ffn_residual(cfg: TransformerConfig, blk, x, comm_ep):
         else:
             ff, aux = moe_ffn_dense(flat, blk["moe"], cfg.capacity)
         return x + ff.reshape(*b_s, d), aux
+    if cfg.ffn == "swiglu":
+        gate_up = y @ blk["w1"]
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        return x + (jax.nn.silu(gate) * up) @ blk["w2"], \
+            jnp.zeros((), x.dtype)
     return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"], \
         jnp.zeros((), x.dtype)
 
@@ -283,7 +316,7 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     aux_total = jnp.zeros((), x.dtype)
 
     def block_fn(x, blk):
-        y = _layer_norm(x, blk["ln1"])
+        y = _norm(cfg, x, blk["ln1"])
         q, k, v = _split_qkv(cfg, blk, y, positions)
         o = _attention(q, k, v, comm_sp, attn, cfg.attn_window)
         x = x + o.reshape(b, s_local, d) @ blk["wo"]
@@ -295,7 +328,7 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     for blk in params["blocks"]:
         x, aux = block_fn(x, blk)
         aux_total = aux_total + aux
-    x = _layer_norm(x, params["ln_f"])
+    x = _norm(cfg, x, params["ln_f"])
     logits = x @ params["unembed"]
     if return_aux:
         return logits, aux_total
@@ -354,7 +387,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
         x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)[0]
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
-        y = _layer_norm(x, blk["ln1"])
+        y = _norm(cfg, x, blk["ln1"])
         q, k_new, v_new = _split_qkv(cfg, blk, y[:, None, :], pos[None])
         ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, pos, 1)
         cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v_new, pos, 1)
@@ -364,7 +397,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
             window=cfg.attn_window, impl="jnp")
         x = x + o.reshape(b, cfg.d_model) @ blk["wo"]
         x, _ = _ffn_residual(cfg, blk, x, None)
-    x = _layer_norm(x, params["ln_f"])
+    x = _norm(cfg, x, params["ln_f"])
     return x @ params["unembed"], new_cache
 
 
@@ -379,7 +412,7 @@ def prefill(cfg: TransformerConfig, params, cache, prompt):
         x = x + params["pos"][None, :p_len]
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
-        y = _layer_norm(x, blk["ln1"])
+        y = _norm(cfg, x, blk["ln1"])
         q, k, v = _split_qkv(cfg, blk, y,
                              jnp.arange(p_len, dtype=jnp.int32))
         ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, 1)
@@ -388,7 +421,7 @@ def prefill(cfg: TransformerConfig, params, cache, prompt):
         o = flash_attention(q, k, v, causal=True, window=cfg.attn_window)
         x = x + o.reshape(b, p_len, cfg.d_model) @ blk["wo"]
         x, _ = _ffn_residual(cfg, blk, x, None)
-    x = _layer_norm(x, params["ln_f"])
+    x = _norm(cfg, x, params["ln_f"])
     return x[:, -1] @ params["unembed"], new_cache
 
 

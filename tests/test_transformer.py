@@ -263,6 +263,87 @@ class TestRoPE:
                                 n_layers=1, d_ff=8, max_seq=8, rope=True)
 
 
+class TestModernArchitecture:
+    """RMSNorm + SwiGLU (+ rope/GQA/window): the llama-family block
+    variants must satisfy their defining formulas and reproduce the
+    single-process run through the distributed step and the decoder."""
+
+    LLAMA = dataclasses.replace(CFG, norm="rmsnorm", ffn="swiglu",
+                                rope=True, n_kv_heads=2)
+
+    def test_rmsnorm_formula(self):
+        rng = np.random.default_rng(41)
+        x = jnp.asarray(rng.standard_normal((3, 16)))
+        p = {"scale": jnp.asarray(rng.standard_normal((16,)))}
+        got = T._rms_norm(x, p)
+        want = x / np.sqrt(np.mean(np.square(np.asarray(x)), -1,
+                                   keepdims=True) + 1e-5) * p["scale"]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-10)
+        # no bias parameter, no centering: adding a constant shifts the
+        # output (unlike LayerNorm, which would be invariant)
+        assert "bias" not in T.init_transformer(
+            jax.random.PRNGKey(0), self.LLAMA, jnp.float64)["ln_f"]
+
+    def test_swiglu_formula(self):
+        cfg = dataclasses.replace(CFG, ffn="swiglu")
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        blk = params["blocks"][0]
+        assert blk["w1"].shape == (CFG.d_model, 2 * CFG.d_ff)
+        rng = np.random.default_rng(42)
+        x = jnp.asarray(rng.standard_normal((2, 4, CFG.d_model)))
+        got, _ = T._ffn_residual(cfg, blk, x, None)
+        y = T._layer_norm(x, blk["ln2"])
+        gate, up = jnp.split(y @ blk["w1"], 2, axis=-1)
+        want = x + (jax.nn.silu(gate) * up) @ blk["w2"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-10)
+
+    @pytest.mark.parametrize("attn,dp,sp", [("ring", 2, 4),
+                                            ("ulysses", 4, 2)])
+    def test_llama_2d_mesh_matches_single_process(self, attn, dp, sp):
+        cfg = self.LLAMA
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab)
+        ref_loss, ref_params = T.train_step(cfg, params, tokens)
+        loss, new_params = make_mesh_step(cfg, dp, sp, attn)(params,
+                                                             tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-12, atol=1e-14)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-11),
+            new_params, ref_params)
+
+    def test_llama_teacher_forced_decode(self):
+        cfg = self.LLAMA
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0,
+                                    cfg.vocab)
+        want = T.forward(cfg, params, tokens)
+        cache = T.init_kv_cache(cfg, 2, jnp.float64)
+        got = []
+        for i in range(S):
+            logits, cache = T.decode_step(cfg, params, cache,
+                                          tokens[:, i], i)
+            got.append(logits)
+        np.testing.assert_allclose(np.asarray(jnp.stack(got, 1)),
+                                   np.asarray(want), rtol=1e-9,
+                                   atol=1e-11)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown norm"):
+            dataclasses.replace(CFG, norm="batchnorm")
+        with pytest.raises(ValueError, match="unknown ffn"):
+            dataclasses.replace(CFG, ffn="relu")
+        with pytest.raises(ValueError, match="swiglu"):
+            dataclasses.replace(CFG, ffn="swiglu", n_experts=2,
+                                capacity=8)
+
+
 def test_gqa_bad_head_ratio_raises():
     with pytest.raises(ValueError, match="multiple of n_kv_heads"):
         dataclasses.replace(CFG, n_kv_heads=3)
