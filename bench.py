@@ -275,10 +275,16 @@ def _bench_train_step(on_tpu: bool, peak: float):
         cfg = T.TransformerConfig(vocab=32768, d_model=2048, n_heads=16,
                                   n_layers=8, d_ff=8192, max_seq=2048)
         batch, dtype, iters = 8, jnp.bfloat16, 10
+        # The dense (batch, seq, vocab) logits alone are 1 GiB bf16 (+
+        # f32 softmax intermediates) per step at this config; the
+        # chunked-vocab loss never materializes them
+        # (models/transformer.py _chunked_ce) — 8 x 4096-wide slabs.
+        vocab_chunk = 4096
     else:
         cfg = T.TransformerConfig(vocab=256, d_model=64, n_heads=4,
                                   n_layers=2, d_ff=128, max_seq=64)
         batch, dtype, iters = 2, jnp.float32, 2
+        vocab_chunk = 64
 
     params = T.init_transformer(jax.random.PRNGKey(0), cfg, dtype=dtype)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.max_seq),
@@ -287,7 +293,8 @@ def _bench_train_step(on_tpu: bool, peak: float):
     @jax.jit
     def step(params, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: T.lm_loss(cfg, p, tokens))(params)
+            lambda p: T.lm_loss(cfg, p, tokens,
+                                vocab_chunk=vocab_chunk))(params)
         new = jax.tree.map(lambda p, g: p - 1e-3 * g.astype(p.dtype),
                            params, grads)
         return loss, new
@@ -308,6 +315,7 @@ def _bench_train_step(on_tpu: bool, peak: float):
         "mfu": round(achieved / peak, 4),
         "n_params": n_params,
         "tokens_per_step": n_tokens,
+        "vocab_chunk": vocab_chunk,
         "dtype": str(jnp.dtype(dtype)),
         "seconds_per_step": dt,
     }

@@ -277,7 +277,8 @@ def _attention(q, k, v, comm_sp, attn: str, window: int = 0):
 
 
 def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
-            attn: str = "ring", comm_ep=None, return_aux: bool = False):
+            attn: str = "ring", comm_ep=None, return_aux: bool = False,
+            return_hidden: bool = False):
     """Logits for a (batch, seq_local) shard of token ids.
 
     ``comm_sp`` is the sequence-parallel communicator (or None for a full
@@ -329,10 +330,13 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
         x, aux = block_fn(x, blk)
         aux_total = aux_total + aux
     x = _norm(cfg, x, params["ln_f"])
-    logits = x @ params["unembed"]
+    if return_hidden:
+        out = x
+    else:
+        out = x @ params["unembed"]
     if return_aux:
-        return logits, aux_total
-    return logits
+        return out, aux_total
+    return out
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
@@ -496,9 +500,56 @@ def generate(cfg: TransformerConfig, params, prompt, n_new: int,
     return jnp.concatenate([prompt, gen.T], axis=1)
 
 
+def _chunked_ce(x, unembed, labels, vocab_chunk: int):
+    """Per-token cross entropy ``logsumexp(z) - z[label]`` computed in
+    vocab chunks under ``lax.scan``: the full (batch, seq, vocab) logits
+    array never materializes — each step computes one (batch, seq,
+    chunk) slab, folds it into a running online logsumexp, and picks the
+    label logit if it falls in the chunk.  At the flagship bench config
+    (vocab 32768, bf16) the dense logits alone are ~1 GiB of HBM per
+    step; chunking caps the transient at chunk/vocab of that, and the
+    backward rebuilds each slab from the O(d) residuals (XLA transposes
+    the scan), trading one extra chunk matmul for the memory."""
+    V = unembed.shape[1]
+    n_chunks = V // vocab_chunk
+    # The online logsumexp runs in at-least-f32 (bf16 running sums would
+    # lose the tail mass the chunking is supposed to preserve exactly).
+    ct = jnp.promote_types(x.dtype, jnp.float32)
+    neg = jnp.asarray(-1e30, ct)
+    m0 = jnp.full(labels.shape, neg, ct)
+    se0 = jnp.zeros(labels.shape, ct)
+    zt0 = jnp.zeros(labels.shape, ct)
+
+    # checkpoint: without it the scan's VJP stacks each step's
+    # (b, s, chunk) slab intermediates across ALL chunks — at the
+    # flagship config that is ~2 GiB f32, i.e. WORSE than the dense
+    # logits this function exists to avoid.  Rematerializing recomputes
+    # one chunk matmul per backward step from the O(d) residuals
+    # instead (same trade as the per-block remat at cfg.remat).
+    @jax.checkpoint
+    def body(carry, c):
+        m, se, zt = carry
+        w = jax.lax.dynamic_slice_in_dim(unembed, c * vocab_chunk,
+                                         vocab_chunk, 1)
+        z = (x @ w).astype(ct)                       # (b, s, chunk)
+        m_new = jnp.maximum(m, jnp.max(z, axis=-1))
+        se = se * jnp.exp(m - m_new) + \
+            jnp.sum(jnp.exp(z - m_new[..., None]), axis=-1)
+        lo = c * vocab_chunk
+        in_chunk = (labels >= lo) & (labels < lo + vocab_chunk)
+        idx = jnp.clip(labels - lo, 0, vocab_chunk - 1)
+        zsel = jnp.take_along_axis(z, idx[..., None], axis=-1)[..., 0]
+        zt = jnp.where(in_chunk, zsel, zt)
+        return (m_new, se, zt), None
+
+    (m, se, zt), _ = jax.lax.scan(
+        body, (m0, se0, zt0), jnp.arange(n_chunks, dtype=jnp.int32))
+    return m + jnp.log(se) - zt
+
+
 def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
             attn: str = "ring", seq_global: Optional[int] = None,
-            comm_ep=None):
+            comm_ep=None, vocab_chunk: int = 0):
     """Mean next-token cross-entropy over the GLOBAL sequence.
 
     The label for a shard's last token lives on the next sp rank — it is
@@ -511,12 +562,19 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
     b, s_local = tokens.shape
     sp = comm_sp.size if comm_sp is not None else 1
     s_global = seq_global or sp * s_local
+    if vocab_chunk and (vocab_chunk <= 0
+                        or cfg.vocab % vocab_chunk != 0):
+        raise ValueError(
+            f"vocab_chunk={vocab_chunk} must divide vocab={cfg.vocab}")
 
+    want_hidden = bool(vocab_chunk) and vocab_chunk < cfg.vocab
     if cfg.n_experts > 0:
-        logits, aux = forward(cfg, params, tokens, comm_sp, attn,
-                              comm_ep=comm_ep, return_aux=True)
+        out, aux = forward(cfg, params, tokens, comm_sp, attn,
+                           comm_ep=comm_ep, return_aux=True,
+                           return_hidden=want_hidden)
     else:
-        logits = forward(cfg, params, tokens, comm_sp, attn)
+        out = forward(cfg, params, tokens, comm_sp, attn,
+                      return_hidden=want_hidden)
         aux = None
 
     if sp > 1:
@@ -527,10 +585,14 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
         labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
         offset = 0
     global_pos = offset + jnp.arange(s_local)
-    mask = (global_pos < s_global - 1).astype(logits.dtype)
+    mask = (global_pos < s_global - 1).astype(out.dtype)
 
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if want_hidden:
+        ce = _chunked_ce(out, params["unembed"], labels, vocab_chunk)
+    else:
+        logp = jax.nn.log_softmax(out, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[..., None],
+                                  axis=-1)[..., 0]
     local_sum = jnp.sum(ce * mask[None, :])
     if sp > 1:
         total = comm_sp.Allreduce(local_sum, MPI_SUM)
