@@ -289,7 +289,10 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
     With ``cfg.n_experts > 0`` each block's FFN is the expert-parallel MoE
     (experts sharded over ``comm_ep``; pass None to keep all experts
     local).  ``return_aux`` additionally returns the summed load-balancing
-    loss.
+    loss.  ``return_hidden`` returns the post-``ln_f`` hidden states
+    (batch, seq_local, d_model) INSTEAD of logits — the unembedding is
+    skipped so :func:`lm_loss`'s chunked-vocab path can fold it into the
+    online-logsumexp scan without ever materializing the logits.
     """
     b, s_local = tokens.shape
     h = cfg.n_heads
