@@ -193,6 +193,17 @@ class MPI_Communicator:
         return self._backend().allgather(tensor, gatheraxis)
 
     @_named_op
+    def Reduce_scatter(self, tensor, op: int, scatteraxis: int):
+        """Element-wise reduce across ranks, result scattered in equal
+        ``scatteraxis`` segments (rank r keeps segment r) — the
+        MPI_Reduce_scatter_block contract.  TPU-native addition (no
+        reference counterpart): under SPMD, MPI_SUM lowers to one native
+        ``psum_scatter`` (half a ring allreduce on the wire) — the ZeRO
+        gradient-sharding primitive (parallel/zero.py).  Only ``MPI_SUM``
+        is differentiable; the adjoint is an allgather."""
+        return self._backend().reduce_scatter(tensor, op, scatteraxis)
+
+    @_named_op
     def Scatter(self, tensor, scatteraxis: int, numelem: int, root: int):
         """Split ``root``'s tensor along ``scatteraxis``; this rank keeps
         ``numelem`` entries.  Non-root input shapes are ignored (reference:
@@ -268,6 +279,9 @@ class _EagerBackend:
 
     def allgather(self, x, gatheraxis):
         return _eager.allgather(self._ctx, x, gatheraxis)
+
+    def reduce_scatter(self, x, op, scatteraxis):
+        return _eager.reduce_scatter(self._ctx, x, op, scatteraxis)
 
     def scatter(self, x, scatteraxis, numelem, root):
         return _eager.scatter(self._ctx, x, scatteraxis, numelem, root)

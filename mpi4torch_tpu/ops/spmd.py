@@ -416,6 +416,52 @@ def allgather(ctx: SpmdContext, x, gatheraxis: int):
     return f(x)
 
 
+def reduce_scatter(ctx: SpmdContext, x, op: int, scatteraxis: int):
+    """SPMD block reduce-scatter (TPU-native addition; no reference
+    counterpart — see ops/eager.py reduce_scatter for the contract).
+
+    MPI_SUM lowers to ONE native ``lax.psum_scatter`` — the wire-optimal
+    collective (half a ring allreduce: (N-1)/N of the tensor on the wire
+    instead of 2(N-1)/N) and the reason this op exists: ZeRO gradient
+    sharding (parallel/zero.py) pays allreduce wire cost without it.
+    Non-SUM ops and deterministic mode take the ordered-fold allreduce +
+    shard slice (exact eager/bit-exactness parity; no native XLA
+    collective exists for them).  Adjoint (SUM only): ``lax.all_gather``
+    of the shard cotangents."""
+    ax = _norm_axis(scatteraxis, jnp.ndim(x))
+    if x.shape[ax] % ctx.size != 0:
+        raise CommError(
+            f"Reduce_scatter axis {scatteraxis} length {x.shape[ax]} must "
+            f"be divisible by the communicator size {ctx.size}")
+    shard = x.shape[ax] // ctx.size
+
+    def fwd_value(v):
+        if op == C.MPI_SUM and not _config.deterministic_reductions():
+            return lax.psum_scatter(v, ctx.axis_name, scatter_dimension=ax,
+                                    tiled=True)
+        total = _allreduce_fwd_value(ctx, v, op)
+        start = lax.axis_index(ctx.axis_name) * shard
+        return lax.dynamic_slice_in_dim(total, start, shard, ax)
+
+    @jax.custom_vjp
+    def f(v):
+        return fwd_value(v)
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Reduce_scatter with {C.op_name(op)} is "
+                "not implemented — only MPI_SUM is differentiable "
+                "(reference: MPIUnimplementedNode, "
+                "csrc/extension.cpp:194-202)"
+            )
+        with _bwd_scope("Reduce_scatter"):
+            return (lax.all_gather(g, ctx.axis_name, axis=ax, tiled=True),)
+
+    f.defvjp(lambda v: (fwd_value(v), None), bwd)
+    return f(x)
+
+
 def gather(ctx: SpmdContext, x, gatheraxis: int, root: int):
     """SPMD gather-to-root (reference: csrc/extension.cpp:497-599): an
     all-gather with non-root results zeroed (the reference's non-root
@@ -719,6 +765,9 @@ class SpmdBackend:
 
     def allgather(self, x, gatheraxis):
         return allgather(self._ctx, x, gatheraxis)
+
+    def reduce_scatter(self, x, op, scatteraxis):
+        return reduce_scatter(self._ctx, x, op, scatteraxis)
 
     def scatter(self, x, scatteraxis, numelem, root):
         return scatter(self._ctx, x, scatteraxis, numelem, root)

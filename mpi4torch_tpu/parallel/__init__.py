@@ -19,7 +19,7 @@ ICI/DCN).
     pp         — pipeline parallelism: GPipe fill-drain over Isend/Irecv
 """
 
-from . import attention, dp, moe, pp, ring, tp
+from . import attention, dp, moe, pp, ring, tp, zero
 
 from .dp import all_average_tree, dp_value_and_grad
 from .ring import halo_exchange, ring_shift
@@ -32,10 +32,13 @@ from .tp import (
     tp_mlp,
 )
 from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
+from .zero import zero_init, zero_step
 from .pp import (pipeline_spmd, pipeline_step, pipeline_step_1f1b,
                  recv_activation, schedule_1f1b, send_activation)
 
 __all__ = [
+    "zero_init",
+    "zero_step",
     "attention",
     "dp",
     "moe",

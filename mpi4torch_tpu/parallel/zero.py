@@ -1,0 +1,89 @@
+"""ZeRO-1: optimizer states sharded over the data-parallel axis.
+
+Plain DP replicates parameters, gradients AND optimizer state on every
+rank; with Adam the state is 2x the parameter bytes, so at scale the
+optimizer dominates HBM.  ZeRO stage 1 (the partitioning of "ZeRO:
+Memory Optimizations Toward Training Trillion Parameter Models",
+PAPERS.md) keeps each rank's optimizer state for only ``1/size`` of the
+parameters:
+
+1. per-rank local gradients are ``Reduce_scatter``'d — each rank
+   receives the GLOBAL gradient for its own shard at half an
+   allreduce's wire cost (the native ``psum_scatter``, ops/spmd.py);
+2. the optimizer update runs on the shard (element-wise optimizers —
+   Adam, momentum SGD, rmsprop — give bit-identical math to the
+   replicated update, so trajectories match the plain-DP oracle
+   exactly);
+3. the updated shards are ``Allgather``'d back into full replicated
+   parameters.
+
+Per step the wire cost equals one gradient allreduce (reduce-scatter +
+allgather = the two halves of a ring allreduce), while optimizer-state
+HBM drops by ``size``x.  Works with any optax-style
+``GradientTransformation`` whose update is element-wise; communicator
+ops are the AD-transparent facade, so the same code runs on the eager
+thread world and the SPMD mesh backend.
+
+Leaves are flattened and zero-padded to a multiple of ``size`` (the
+pad slots carry zero gradients, so their shard state stays zero and
+the unpad after the allgather is exact).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import MPI_SUM
+
+
+def _shard_len(n: int, size: int) -> int:
+    return -(-n // size)  # ceil: padded flat length per rank
+
+
+def _pad_flat(x, size: int):
+    flat = x.reshape(-1)
+    per = _shard_len(flat.shape[0], size)
+    return jnp.pad(flat, (0, per * size - flat.shape[0]))
+
+
+def _my_shard(comm, flat_padded):
+    per = flat_padded.shape[0] // comm.size
+    start = jnp.asarray(comm.rank) * per
+    return jax.lax.dynamic_slice_in_dim(flat_padded, start, per, 0)
+
+
+def zero_init(comm, opt, params):
+    """Optimizer state for this rank's parameter shards: ``opt.init`` on
+    the sharded-and-padded view — ``1/size`` of the replicated state."""
+    shards = jax.tree.map(
+        lambda p: _my_shard(comm, _pad_flat(p, comm.size)), params)
+    return opt.init(shards)
+
+
+def zero_step(comm, opt, params, local_grads, opt_state):
+    """One ZeRO-1 update; returns ``(new_params, new_opt_state)``.
+
+    ``local_grads`` are this rank's UN-reduced loss gradients (their sum
+    over ranks is the global gradient — e.g. ``jax.grad`` of the local
+    loss WITHOUT the DP loss-Allreduce; the reduction happens here, in
+    the reduce-scatter).  The updated parameters return fully
+    replicated, ready for the next forward."""
+    size = comm.size
+
+    def grad_shard(g):
+        rs = comm.Reduce_scatter(_pad_flat(g, size), MPI_SUM, 0)
+        return rs / size          # mean over ranks, matching plain DP
+
+    g_shards = jax.tree.map(grad_shard, local_grads)
+    p_shards = jax.tree.map(
+        lambda p: _my_shard(comm, _pad_flat(p, size)), params)
+    updates, new_state = opt.update(g_shards, opt_state, p_shards)
+    p_shards = jax.tree.map(jnp.add, p_shards, updates)
+
+    def regather(shard, p):
+        full = comm.Allgather(shard, 0)
+        return full[:p.size].reshape(p.shape)
+
+    new_params = jax.tree.map(regather, p_shards, params)
+    return new_params, new_state

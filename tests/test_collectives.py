@@ -187,6 +187,68 @@ class TestAllgather:
         run_ranks(body, nranks)
 
 
+class TestReduceScatter:
+    """TPU-native addition (no reference counterpart): block
+    reduce-scatter — rank r keeps segment r of the rank-ordered
+    reduction.  Adjoint (SUM only) is the allgather."""
+
+    def test_forward_value(self, nranks):
+        def body():
+            # rank r contributes r+1 everywhere; segment values are
+            # sum(1..size) regardless of segment.
+            x = jnp.ones((nranks * 3,)) * (comm.rank + 1)
+            out = comm.Reduce_scatter(x, mpi.MPI_SUM, 0)
+            assert out.shape == (3,)
+            assert (out == nranks * (nranks + 1) / 2).all()
+
+        run_ranks(body, nranks)
+
+    def test_allgather_of_reduce_scatter_is_allreduce(self, nranks):
+        def body():
+            rng = np.random.default_rng(comm.rank)
+            x = jnp.asarray(rng.standard_normal((nranks * 2, 3)))
+            rs = comm.Reduce_scatter(x, mpi.MPI_SUM, 0)
+            ag = comm.Allgather(rs, 0)
+            ar = comm.Allreduce(x, mpi.MPI_SUM)
+            np.testing.assert_allclose(np.asarray(ag), np.asarray(ar),
+                                       rtol=1e-12)
+
+        run_ranks(body, nranks)
+
+    def test_grad_is_allgather(self, nranks):
+        # loss = sum(w_r * out_r) per rank; d loss_total / dx on every
+        # rank is the concatenation of the per-rank weights along the
+        # scatter axis (the allgather adjoint).
+        def body():
+            x = jnp.ones((nranks * 2,))
+            w = float(comm.rank + 1)
+            g = jax.grad(lambda t: jnp.sum(
+                w * comm.Reduce_scatter(t, mpi.MPI_SUM, 0)))(x)
+            want = np.repeat(np.arange(1, nranks + 1, dtype=float), 2)
+            np.testing.assert_array_equal(np.asarray(g), want)
+
+        run_ranks(body, nranks)
+
+    def test_non_sum_forward_ok_backward_raises(self, nranks):
+        def body():
+            x = jnp.ones((nranks,)) * (comm.rank + 1)
+            out = comm.Reduce_scatter(x, mpi.MPI_MAX, 0)
+            assert (out == nranks).all()
+            with pytest.raises(RuntimeError, match="MPI_MAX"):
+                jax.grad(lambda t: comm.Reduce_scatter(
+                    t, mpi.MPI_MAX, 0).sum())(x)
+
+        run_ranks(body, nranks)
+
+    def test_indivisible_axis_raises(self, nranks):
+        def body():
+            with pytest.raises(mpi.CommError, match="divisible"):
+                comm.Reduce_scatter(jnp.ones((nranks * 2 + 1,)),
+                                    mpi.MPI_SUM, 0)
+
+        run_ranks(body, nranks)
+
+
 class TestScatter:
     def test_basic_functionality(self, nranks):
         # reference: tests/test_collectives.py:82-90 — non-root input shapes

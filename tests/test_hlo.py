@@ -59,6 +59,22 @@ class TestForwardCensus:
         got = census(lambda c, x: c.Allreduce(x, mpi.MPI_SUM), SMALL)
         assert got == only(all_reduce=1)
 
+    def test_reduce_scatter_is_one_native_collective(self):
+        # The op's existence case: ONE stablehlo.reduce_scatter — half an
+        # allreduce on the wire (the ZeRO gradient-sharding path).
+        got = census(lambda c, x: c.Reduce_scatter(x, mpi.MPI_SUM, 0),
+                     jnp.ones((NR * 4,)))
+        assert got == only(reduce_scatter=1)
+
+    def test_reduce_scatter_fwd_bwd_is_rs_plus_allgather(self):
+        # value_and_grad keeps the forward live (plain grad would DCE the
+        # psum_scatter: sum's cotangent is primal-independent).
+        got = census(
+            lambda c, x: jax.value_and_grad(lambda v: jnp.sum(
+                c.Reduce_scatter(v, mpi.MPI_SUM, 0)))(x),
+            jnp.ones((NR * 4,)))
+        assert got == only(reduce_scatter=1, all_gather=1)
+
     def test_bcast_small_is_log2_permutes(self):
         got = census(lambda c, x: c.Bcast_(x, root=1), SMALL)
         assert got == only(collective_permute=math.ceil(math.log2(NR)))
