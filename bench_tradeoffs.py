@@ -126,6 +126,41 @@ def bench_deterministic_overhead(n):
     return out
 
 
+def bench_reduce_scatter(n):
+    """Reduce_scatter vs Allreduce-then-slice (the ZeRO gradient path;
+    parallel/zero.py).  On a multi-chip mesh the native psum_scatter is
+    half the allreduce's wire; on one chip both are HBM-bound but the
+    slice variant still writes the full-length result first."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4torch_tpu as mpi
+
+    results = []
+    for log2_bytes in ((20, 24, 26) if _on_tpu() else (16,)):
+        nelem = (1 << log2_bytes) // 4
+        nelem -= nelem % n
+        x = jnp.ones((nelem,), jnp.float32)
+        shard = nelem // n
+
+        def rs(x):
+            return mpi.COMM_WORLD.Reduce_scatter(x, mpi.MPI_SUM, 0)
+
+        def ar_slice(x):
+            full = mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM)
+            start = jnp.asarray(mpi.COMM_WORLD.rank) * shard
+            return jax.lax.dynamic_slice_in_dim(full, start, shard, 0)
+
+        t_rs = _timeit(mpi.run_spmd(rs, nranks=n), x, iters=10)
+        t_ar = _timeit(mpi.run_spmd(ar_slice, nranks=n), x, iters=10)
+        results.append({"bytes": nelem * 4, "reduce_scatter_s": t_rs,
+                        "allreduce_slice_s": t_ar,
+                        "speedup": t_ar / t_rs})
+        _note(f"reduce_scatter {nelem * 4}B: {t_rs:.2e}s vs "
+              f"allreduce+slice {t_ar:.2e}s")
+    return results
+
+
 def main():
     import os
 
@@ -145,7 +180,8 @@ def main():
               "n_devices": n}
     for name, fn in (("bcast_crossover", bench_bcast_crossover),
                      ("gather_cost", bench_gather_cost),
-                     ("deterministic", bench_deterministic_overhead)):
+                     ("deterministic", bench_deterministic_overhead),
+                     ("reduce_scatter", bench_reduce_scatter)):
         try:
             result[name] = fn(n)
         except Exception as e:  # noqa: BLE001 — partial results still print

@@ -424,10 +424,11 @@ def reduce_scatter(ctx: SpmdContext, x, op: int, scatteraxis: int):
     collective (half a ring allreduce: (N-1)/N of the tensor on the wire
     instead of 2(N-1)/N) and the reason this op exists: ZeRO gradient
     sharding (parallel/zero.py) pays allreduce wire cost without it.
-    Non-SUM ops and deterministic mode take the ordered-fold allreduce +
-    shard slice (exact eager/bit-exactness parity; no native XLA
-    collective exists for them).  Adjoint (SUM only): ``lax.all_gather``
-    of the shard cotangents."""
+    Non-SUM ops and deterministic mode reduce via
+    ``_allreduce_fwd_value`` + shard slice (native pmax/pmin where XLA
+    has them, the bit-exact ordered fold for the rest and for SUM under
+    deterministic mode).  Adjoint (SUM only): ``lax.all_gather`` of the
+    shard cotangents."""
     ax = _norm_axis(scatteraxis, jnp.ndim(x))
     if x.shape[ax] % ctx.size != 0:
         raise CommError(
@@ -439,9 +440,26 @@ def reduce_scatter(ctx: SpmdContext, x, op: int, scatteraxis: int):
         if op == C.MPI_SUM and not _config.deterministic_reductions():
             return lax.psum_scatter(v, ctx.axis_name, scatter_dimension=ax,
                                     tiled=True)
-        total = _allreduce_fwd_value(ctx, v, op)
         start = lax.axis_index(ctx.axis_name) * shard
-        return lax.dynamic_slice_in_dim(total, start, shard, ax)
+        if op in (C.MPI_MAX, C.MPI_MIN):
+            # One native collective covers the full tensor; slice after.
+            total = _allreduce_fwd_value(ctx, v, op)
+            return lax.dynamic_slice_in_dim(total, start, shard, ax)
+        if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+            C.combine2(op, v, v)  # raises NotImplementedError
+        # Ordered fold (SUM under deterministic mode, and ops with no
+        # native collective): slice each rank's contribution to MY
+        # segment BEFORE folding — the element-wise fold commutes with
+        # slicing (bit-identical to the eager oracle) at 1/size the
+        # reduction work; XLA does NOT push the slice through the fold
+        # itself (verified on compiled HLO: the adds stay full-length
+        # when slicing after).
+        stacked = lax.all_gather(v, ctx.axis_name, axis=0, tiled=False)
+        pieces = lax.dynamic_slice_in_dim(stacked, start, shard, 1 + ax)
+        out = pieces[0]
+        for i in range(1, ctx.size):
+            out = C.combine2(op, out, pieces[i])
+        return out
 
     @jax.custom_vjp
     def f(v):
