@@ -183,6 +183,108 @@ def pipeline_spmd(comm, apply_stage: Callable[[Any, Any], Any],
     return total
 
 
+def pipeline_step_interleaved(comm, apply_stage: Callable[[Any, Any], Any],
+                              chunk_params: List, microbatches: List,
+                              loss_fn: Callable[[Any, int], Any],
+                              recv_like=None, tag: int = 0):
+    """One training step with INTERLEAVED virtual pipeline stages
+    (Megatron-style): rank ``r`` owns ``v = len(chunk_params)``
+    non-contiguous stage chunks — global stage ``s`` of ``v*size`` lives
+    on rank ``s % size``, chunk ``s // size``.  Returns ``(loss, grads)``
+    where ``grads`` matches ``chunk_params``' structure.
+
+    Interleaving cuts the pipeline bubble by ``v``: each per-rank stage
+    is 1/v the work, so fill/drain cost ``(size-1)/(v*n_mb)`` of a step
+    instead of ``(size-1)/n_mb``.  The transport is the same buffered
+    p2p substrate as :func:`pipeline_step_1f1b` (per-microbatch
+    ``jax.vjp`` pullbacks, cotangents on their own tag range); the
+    schedule here is breadth-first (all forwards, then all backwards in
+    reverse) — activation stashes are ``n_mb * v`` like GPipe.
+    ``recv_like`` is required whenever this rank ever receives (i.e.
+    unless ``size == 1``); every chunk boundary must preserve the
+    activation shape/dtype (uniform-width pipelines)."""
+    rank, size = int(comm.rank), comm.size
+    v = len(chunk_params)
+    n_mb = len(microbatches)
+    n_stages = v * size
+    if size == 1:
+        def solo(ps):
+            total = jnp.zeros(())
+            for i, mb in enumerate(microbatches):
+                x = mb
+                for p in ps:
+                    x = apply_stage(p, x)
+                total = total + loss_fn(x, i)
+            return total
+        return jax.value_and_grad(solo)(chunk_params)
+    if recv_like is None:
+        raise ValueError("size > 1 needs recv_like (stage boundary "
+                         "activation shape/dtype)")
+
+    # tag layout: forward msg for (mb i, global stage s) travels on
+    # tag + s*n_mb + i; the matching cotangent on bwd_base + the same.
+    bwd_base = tag + n_stages * n_mb
+    last_stage = n_stages - 1
+    stash = {}                     # (i, chunk) -> pullback
+    total = jnp.zeros(())
+    grads = jax.tree.map(jnp.zeros_like, chunk_params)
+
+    def owner(s):
+        return s % size, s // size      # (rank, chunk)
+
+    # ---- forward: BREADTH-FIRST (stage-outer, microbatch-inner) ------
+    # The loop order is the schedule (receives block until the producer
+    # sent): stage-outer lets every microbatch clear stage s before any
+    # rank needs stage s+1's output, so each rank's idle time is the
+    # fill of ONE 1/v-sized chunk — the bubble cut interleaving exists
+    # for.  Microbatch-outer would serialize each microbatch through all
+    # v chunks of a rank before the next could start (worse than plain
+    # GPipe).
+    for s in range(n_stages):
+        r, c = owner(s)
+        if r != rank:
+            continue
+        for i in range(n_mb):
+            if s == 0:
+                x = microbatches[i]
+            else:
+                x = comm.Recv(jnp.zeros_like(recv_like), (s - 1) % size,
+                              tag + s * n_mb + i)
+            if s == last_stage:
+                li, pull = jax.vjp(
+                    lambda p, x: loss_fn(apply_stage(p, x), i),
+                    chunk_params[c], x)
+                total = total + li
+                stash[(i, c)] = (pull, None)
+            else:
+                y, pull = jax.vjp(apply_stage, chunk_params[c], x)
+                comm.Send(y, (s + 1) % size, tag + (s + 1) * n_mb + i)
+                # Cotangent buffers come from the stashed output aval
+                # (like pipeline_step_1f1b), not recv_like: exact even
+                # if a chunk boundary changes the activation shape.
+                stash[(i, c)] = (pull, jax.eval_shape(lambda: y))
+
+    # ---- backward: exact reverse ------------------------------------
+    for s in reversed(range(n_stages)):
+        r, c = owner(s)
+        if r != rank:
+            continue
+        for i in reversed(range(n_mb)):
+            pull, out_aval = stash.pop((i, c))
+            if s == last_stage:
+                ct = jnp.ones(())
+            else:
+                ct = comm.Recv(jnp.zeros(out_aval.shape, out_aval.dtype),
+                               (s + 1) % size, bwd_base + (s + 1) * n_mb + i)
+            dp, dx = pull(ct)
+            grads[c] = jax.tree.map(jnp.add, grads[c], dp)
+            if s > 0:
+                comm.Send(dx, (s - 1) % size, bwd_base + s * n_mb + i)
+
+    loss = comm.Bcast_(total, last_stage % size)
+    return loss, grads
+
+
 def schedule_1f1b(rank: int, size: int, n_mb: int):
     """The 1F1B order for one stage: ``[("F", i) | ("B", i)]``.
 

@@ -340,3 +340,81 @@ class TestPipelineSPMD:
             np.testing.assert_allclose(
                 np.asarray(g[k]), NR * g_d[k], rtol=1e-9, atol=1e-12,
                 err_msg=f"stacked grad {k}")
+
+
+class TestInterleaved:
+    """Interleaved virtual stages (Megatron-style): rank r owns chunks
+    {r, size+r, 2*size+r, ...} of v*size global stages; loss and grads
+    must equal the sequential oracle exactly."""
+
+    @pytest.mark.parametrize("nranks,v,n_mb", [(2, 2, 3), (4, 2, 4),
+                                               (2, 3, 2)])
+    def test_loss_and_grads_match_sequential(self, nranks, v, n_mb):
+        from mpi4torch_tpu.parallel import pipeline_step_interleaved
+
+        n_stages = nranks * v
+        rng = np.random.default_rng(nranks * 100 + v * 10 + n_mb)
+        stages = [{
+            "w": jnp.asarray(rng.standard_normal((D, D)) / np.sqrt(D)),
+            "b": jnp.asarray(rng.standard_normal(D) * 0.1),
+        } for _ in range(n_stages)]
+        mbs = [jnp.asarray(rng.standard_normal((B, D)))
+               for _ in range(n_mb)]
+        val_d, g_d = sequential_oracle(stages, mbs)
+
+        def body():
+            r = int(comm.rank)
+            # rank r's chunks are global stages r, size + r, ...
+            mine = [stages[c * nranks + r] for c in range(v)]
+            loss, g = pipeline_step_interleaved(
+                comm, apply_stage, mine, mbs, loss_fn,
+                recv_like=jnp.zeros((B, D)))
+            return np.asarray(loss), jax.tree.map(np.asarray, g)
+
+        outs = mpi.run_ranks(body, nranks)
+        for r in range(nranks):
+            loss, g = outs[r]
+            np.testing.assert_allclose(loss, val_d, rtol=1e-12,
+                                       err_msg=f"rank {r} loss")
+            for c in range(v):
+                for k in ("w", "b"):
+                    np.testing.assert_allclose(
+                        g[c][k], np.asarray(g_d[c * nranks + r][k]),
+                        rtol=1e-9, atol=1e-12,
+                        err_msg=f"rank {r} chunk {c} grad {k}")
+
+    def test_size_one_is_sequential(self):
+        from mpi4torch_tpu.parallel import pipeline_step_interleaved
+
+        rng = np.random.default_rng(5)
+        stages = [{
+            "w": jnp.asarray(rng.standard_normal((D, D)) / np.sqrt(D)),
+            "b": jnp.asarray(rng.standard_normal(D) * 0.1),
+        } for _ in range(3)]
+        mbs = [jnp.asarray(rng.standard_normal((B, D))) for _ in range(2)]
+        val_d, g_d = sequential_oracle(stages, mbs)
+
+        def body():
+            loss, g = pipeline_step_interleaved(
+                comm, apply_stage, stages, mbs, loss_fn)
+            return np.asarray(loss), jax.tree.map(np.asarray, g)
+
+        loss, g = mpi.run_ranks(body, 1)[0]
+        np.testing.assert_allclose(loss, val_d, rtol=1e-12)
+        for c in range(3):
+            for k in ("w", "b"):
+                np.testing.assert_allclose(g[c][k],
+                                           np.asarray(g_d[c][k]),
+                                           rtol=1e-9, atol=1e-12)
+
+    def test_missing_recv_like_raises(self):
+        from mpi4torch_tpu.parallel import pipeline_step_interleaved
+
+        def body():
+            with pytest.raises(ValueError, match="recv_like"):
+                pipeline_step_interleaved(
+                    comm, apply_stage, [{"w": jnp.eye(D)}],
+                    [jnp.zeros((B, D))], loss_fn)
+            return True
+
+        assert all(mpi.run_ranks(body, 2))
