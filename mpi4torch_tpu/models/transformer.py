@@ -29,6 +29,7 @@ from ..parallel.attention import ring_attention, \
     ulysses_attention
 from ..parallel.dp import all_average_tree
 from ..parallel.moe import init_moe, moe_ffn, moe_ffn_dense
+from ..parallel.zero import zero_step
 from ..parallel.ring import ring_shift
 
 
@@ -610,6 +611,50 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
             aux = comm_sp.Allreduce(aux, MPI_SUM) / sp
         loss = loss + cfg.aux_coef * aux
     return loss
+
+
+def zero_train_step(cfg: TransformerConfig, params, tokens, opt,
+                    opt_state, comm_dp, comm_sp=None, attn: str = "ring",
+                    comm_ep=None):
+    """One optimizer step with ZeRO-1 sharded state over the dp axis;
+    returns ``(loss, new_params, new_opt_state)``.
+
+    The data-parallel reduction moves out of the loss and into
+    :func:`~mpi4torch_tpu.parallel.zero.zero_step`'s reduce-scatter:
+    each dp rank differentiates its LOCAL mean loss (no dp
+    param-averaging, no dp loss-Allreduce — the un-reduced gradients
+    are exactly what the reduce-scatter sums), the element-wise ``opt``
+    update runs on this rank's 1/dp parameter shard, and the allgather
+    re-replicates.  Sequence parallelism composes unchanged inside the
+    local loss (the sp discipline of :func:`train_step`).  Trajectories
+    match replicated-DP optax training exactly
+    (tests/test_transformer.py); optimizer-state HBM is 1/dp of
+    replicated — with Adam at scale, the dominant memory term.
+
+    The ep axis composes like in :func:`train_step` (a data axis with
+    the param-averaging adjoint + loss averaging), so every dp rank's
+    local gradient is already ep-consistent before the dp
+    reduce-scatter."""
+
+    def local_loss(p):
+        if comm_sp is not None and comm_sp.size > 1:
+            p = all_average_tree(comm_sp, p)
+        if comm_ep is not None and comm_ep.size > 1:
+            p = all_average_tree(comm_ep, p)
+        loss = lm_loss(cfg, p, tokens, comm_sp, attn, comm_ep=comm_ep)
+        if comm_ep is not None and comm_ep.size > 1:
+            loss = comm_ep.Allreduce(loss, MPI_SUM) / comm_ep.size
+        return loss
+
+    loss, grads = jax.value_and_grad(local_loss)(params)
+    # zero_step's reduce-scatter/size turns the un-reduced local grads
+    # into the dp-MEAN gradient shard — the same mean the plain recipe's
+    # Allreduce/size produces (no scaling here, or it would double).
+    new_params, new_state = zero_step(comm_dp, opt, params, grads,
+                                      opt_state)
+    # Report the dp-global mean loss.
+    loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+    return loss, new_params, new_state
 
 
 def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
