@@ -164,3 +164,12 @@ def test_generate_kv_cache():
     mod = _load("generate_kv_cache")
     gen, want = mod.main(2)
     assert (gen == want).mean() >= 0.9
+
+
+def test_zero_sharded_optimizer():
+    # ZeRO-1 example: sharded-Adam params equal the replicated oracle on
+    # every rank (asserted inside main).
+    mod = _load("zero_sharded_optimizer")
+    got, ref = mod.main(4)
+    np.testing.assert_allclose(np.asarray(got["b"]), np.asarray(ref["b"]),
+                               rtol=1e-9)
