@@ -32,13 +32,14 @@ from .tp import (
     tp_mlp,
 )
 from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
-from .zero import zero_init, zero_step
+from .zero import shard_global_norm, zero_init, zero_step
 from .pp import (pipeline_spmd, pipeline_step, pipeline_step_1f1b,
                  pipeline_step_interleaved,
                  recv_activation, schedule_1f1b, send_activation)
 
 __all__ = [
     "pipeline_step_interleaved",
+    "shard_global_norm",
     "zero_init",
     "zero_step",
     "attention",

@@ -53,6 +53,32 @@ def _my_shard(comm, flat_padded):
     return jax.lax.dynamic_slice_in_dim(flat_padded, start, per, 0)
 
 
+def shard_global_norm(comm, shards):
+    """Global L2 norm of a gradient whose leaves are distributed as
+    this rank's ZeRO shards (the output of :func:`zero_step`'s internal
+    reduce-scatter, or any tree produced by the same sharding).
+
+    Shards of one tensor are DISJOINT segments across ranks, so the
+    true global norm is ``sqrt(Allreduce(sum of local squares))`` —
+    NOT the norm of the local shards.  This matters because global-norm
+    gradient clipping (e.g. ``optax.clip_by_global_norm`` chained
+    before Adam) is the one common optimizer component that is *not*
+    element-wise: applied naively inside :func:`zero_step` it would
+    clip each rank by its own shard norm, silently diverging from the
+    replicated-DP trajectory.  Compute the norm with this helper and
+    scale the gradients by ``max_norm / maximum(norm, max_norm)``
+    instead — the same scalar on every rank, preserving exactness, and
+    safe at ``norm == 0`` (a ``min(1, max_norm/norm)`` form divides by
+    zero on an all-zero gradient; optax's own clip guards this case).
+
+    Padding note: :func:`zero_step` zero-pads flattened leaves, and
+    zeros contribute nothing to the sum of squares, so the result
+    equals the unpadded global norm exactly."""
+    local_sq = sum(jnp.sum(jnp.square(s))
+                   for s in jax.tree.leaves(shards))
+    return jnp.sqrt(comm.Allreduce(local_sq, MPI_SUM))
+
+
 def zero_init(comm, opt, params):
     """Optimizer state for this rank's parameter shards: ``opt.init`` on
     the sharded-and-padded view — ``1/size`` of the replicated state."""
@@ -61,14 +87,23 @@ def zero_init(comm, opt, params):
     return opt.init(shards)
 
 
-def zero_step(comm, opt, params, local_grads, opt_state):
+def zero_step(comm, opt, params, local_grads, opt_state,
+              grad_transform=None):
     """One ZeRO-1 update; returns ``(new_params, new_opt_state)``.
 
     ``local_grads`` are this rank's UN-reduced loss gradients (their sum
     over ranks is the global gradient — e.g. ``jax.grad`` of the local
     loss WITHOUT the DP loss-Allreduce; the reduction happens here, in
     the reduce-scatter).  The updated parameters return fully
-    replicated, ready for the next forward."""
+    replicated, ready for the next forward.
+
+    ``grad_transform(g_shards) -> g_shards`` runs AFTER the
+    reduce-scatter, on the sharded global-mean gradients — the hook for
+    the one common non-element-wise component, global-norm clipping:
+    compute the TRUE norm with :func:`shard_global_norm` and scale by
+    the same scalar on every rank (a shard-local
+    ``optax.clip_by_global_norm`` inside ``opt`` would clip each rank
+    by its own shard norm and silently diverge from replicated DP)."""
     size = comm.size
 
     def grad_shard(g):
@@ -76,6 +111,8 @@ def zero_step(comm, opt, params, local_grads, opt_state):
         return rs / size          # mean over ranks, matching plain DP
 
     g_shards = jax.tree.map(grad_shard, local_grads)
+    if grad_transform is not None:
+        g_shards = grad_transform(g_shards)
     p_shards = jax.tree.map(
         lambda p: _my_shard(comm, _pad_flat(p, size)), params)
     updates, new_state = opt.update(g_shards, opt_state, p_shards)

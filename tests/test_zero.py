@@ -116,3 +116,91 @@ def test_state_is_sharded():
         return True
 
     assert all(mpi.run_ranks(body, NR))
+
+
+def test_global_norm_clipping_matches_replicated():
+    """Global-norm clipping through the grad_transform hook: the sharded
+    norm helper must reproduce optax.chain(clip_by_global_norm, adam)
+    on the replicated oracle exactly — shard-LOCAL clipping would not
+    (each rank would scale by a different factor)."""
+    x, y, params0 = _data()
+    max_norm = 0.5  # far below the actual grad norm: clipping engages
+    chain = optax.chain(optax.clip_by_global_norm(max_norm),
+                        optax.adam(1e-1))
+    ref = _replicated_oracle(chain, x, y, params0)
+    shard = N // NR
+
+    from mpi4torch_tpu.parallel import shard_global_norm
+
+    def body():
+        xl = x[comm.rank * shard:(comm.rank + 1) * shard]
+        yl = y[comm.rank * shard:(comm.rank + 1) * shard]
+        opt = optax.adam(1e-1)
+        params = params0
+        state = zero_init(comm, opt, params)
+
+        def clip(gs):
+            # The documented zero-safe form (NaN-free at norm == 0).
+            norm = shard_global_norm(comm, gs)
+            scale = max_norm / jnp.maximum(norm, max_norm)
+            return jax.tree.map(lambda g: g * scale, gs)
+
+        for _ in range(STEPS):
+            g = jax.grad(lambda p: _local_loss(p, xl, yl))(params)
+            params, state = zero_step(comm, opt, params, g, state,
+                                      grad_transform=clip)
+        return params
+
+    outs = mpi.run_ranks(body, NR)
+    for got in outs:
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-12),
+            got, ref)
+
+    # Same thing on the SPMD mesh backend (symbolic rank, psum
+    # lowering, 0-d scalar Allreduce inside the norm).
+    def spmd_body():
+        r = jnp.asarray(comm.rank)
+        xl = jax.lax.dynamic_slice_in_dim(x, r * shard, shard, 0)
+        yl = jax.lax.dynamic_slice_in_dim(y, r * shard, shard, 0)
+        opt = optax.adam(1e-1)
+        params, state = params0, zero_init(comm, opt, params0)
+
+        def clip(gs):
+            norm = shard_global_norm(comm, gs)
+            scale = max_norm / jnp.maximum(norm, max_norm)
+            return jax.tree.map(lambda g: g * scale, gs)
+
+        for _ in range(STEPS):
+            g = jax.grad(lambda p: _local_loss(p, xl, yl))(params)
+            params, state = zero_step(comm, opt, params, g, state,
+                                      grad_transform=clip)
+        return params
+
+    stacked = mpi.run_spmd(spmd_body, nranks=NR)()
+    for rank in range(NR):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a)[rank], np.asarray(b), rtol=1e-9,
+                atol=1e-12),
+            stacked, ref)
+
+
+def test_shard_global_norm_equals_full_norm():
+    rng = np.random.default_rng(3)
+    tree = {"a": jnp.asarray(rng.standard_normal((13,))),
+            "b": jnp.asarray(rng.standard_normal((3, 5)))}
+    want = float(jnp.sqrt(sum(jnp.sum(jnp.square(v))
+                              for v in tree.values())))
+
+    from mpi4torch_tpu.parallel import shard_global_norm
+    from mpi4torch_tpu.parallel.zero import _my_shard, _pad_flat
+
+    def body():
+        shards = jax.tree.map(
+            lambda p: _my_shard(comm, _pad_flat(p, comm.size)), tree)
+        return float(shard_global_norm(comm, shards))
+
+    for got in mpi.run_ranks(body, NR):
+        np.testing.assert_allclose(got, want, rtol=1e-12)
