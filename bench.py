@@ -264,6 +264,92 @@ def _bench_flash(on_tpu: bool, peak: float):
     }
 
 
+def _bench_flash_reference_ratio(on_tpu: bool):
+    """Race our Pallas flash kernel against JAX's own TPU flash attention
+    (``jax.experimental.pallas.ops.tpu.flash_attention``) fwd+bwd at the
+    bench shape — the one head-to-head opponent measurable on a single
+    chip, so "matching-or-beating on perf" has a number (VERDICT r4
+    item 2).  ``ratio`` is ours_tflops / jax_tflops = jax_s / ours_s;
+    >= 1.0 means ours wins.  On CPU the opponent kernel has no lowering,
+    so the smoke path races the module's own jnp reference instead
+    (harness check only; the ratio is labeled)."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4torch_tpu.ops import flash
+
+    if on_tpu:
+        b, s, h, d, dtype, iters = 4, 4096, 8, 128, jnp.bfloat16, 20
+    else:
+        b, s, h, d, dtype, iters = 1, 256, 2, 64, jnp.float32, 2
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), dtype) for kk in keys)
+
+    def ours_loss(q, k, v):
+        out = flash.flash_attention(q, k, v, causal=True, impl="auto")
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    ours = jax.jit(jax.value_and_grad(ours_loss, argnums=(0, 1, 2)))
+    dt_ours = _timeit(ours, q, k, v, iters=iters)
+
+    sm_scale = 1.0 / math.sqrt(d)   # our kernel's fixed convention
+    if on_tpu:
+        from jax.experimental.pallas.ops.tpu import flash_attention as jfa
+
+        # JAX's kernel wants (batch, heads, seq, head_dim).  Hand it
+        # pre-transposed inputs so the timed region is kernel-only on both
+        # sides — a transpose inside the jitted opponent would charge it
+        # ~6 layout copies per fwd+bwd step and bias the ratio our way.
+        def jax_loss(qh, kh, vh):
+            out = jfa.flash_attention(qh, kh, vh, causal=True,
+                                      sm_scale=sm_scale)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        opponent = "jax.experimental.pallas.ops.tpu.flash_attention"
+        jq, jk, jv = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    else:
+        def jax_loss(qh, kh, vh):
+            out = flash.flash_attention(qh, kh, vh, causal=True, impl="jnp")
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        opponent = "jnp reference (cpu smoke; no TPU opponent available)"
+        jq, jk, jv = q, k, v
+
+    theirs = jax.jit(jax.value_and_grad(jax_loss, argnums=(0, 1, 2)))
+    dt_jax = _timeit(theirs, jq, jk, jv, iters=iters)
+
+    # Same computation check: fwd outputs must agree to dtype tolerance.
+    ours_out = flash.flash_attention(q, k, v, causal=True, impl="auto")
+    if on_tpu:
+        from jax.experimental.pallas.ops.tpu import flash_attention as jfa
+
+        jax_out = jfa.flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True,
+            sm_scale=sm_scale).transpose(0, 2, 1, 3)
+    else:
+        jax_out = flash.flash_attention(q, k, v, causal=True, impl="jnp")
+    max_diff = float(jnp.max(jnp.abs(ours_out.astype(jnp.float32)
+                                     - jax_out.astype(jnp.float32))))
+
+    fwd = 2.0 * b * h * s * s * d          # causal: half of 2*2*B*H*S^2*D
+    flops = 3.0 * fwd
+    return {
+        "shape": [b, s, h, d],
+        "dtype": str(jnp.dtype(dtype)),
+        "opponent": opponent,
+        "ours_s": dt_ours,
+        "jax_s": dt_jax,
+        "ours_tflops": round(flops / dt_ours / 1e12, 3),
+        "jax_tflops": round(flops / dt_jax / 1e12, 3),
+        "ratio": round(dt_jax / dt_ours, 4),
+        "fwd_max_abs_diff": max_diff,
+    }
+
+
 def _bench_train_step(on_tpu: bool, peak: float):
     """Flagship transformer fwd+bwd+update MFU (6*N*T accounting)."""
     import jax
@@ -372,6 +458,8 @@ def main() -> None:
 
         ar = _guarded("allreduce", _bench_allreduce, on_tpu, hbm)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
+        ratio_res = _guarded("flash_reference_ratio",
+                             _bench_flash_reference_ratio, on_tpu)
         train_res = _guarded("train_step", _bench_train_step, on_tpu, peak)
 
         target_gbps = 36.0  # 0.8 * ~45 GB/s v5e ICI per-link (BASELINE.md)
@@ -388,6 +476,7 @@ def main() -> None:
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,
+            "flash_reference_ratio": ratio_res,
             "train_step": train_res,
             "note": ("ring-allreduce bytes-on-wire accounting"
                      if (ar.get("n_devices") or 1) > 1 else

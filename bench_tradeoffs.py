@@ -126,6 +126,46 @@ def bench_deterministic_overhead(n):
     return out
 
 
+def bench_ordered_fold_paths(n):
+    """Gather-fold vs chunked-ring-fold deterministic Allreduce (VERDICT r4
+    item 3): both are bit-identical; this measures the memory/latency trade
+    to calibrate ``_ORDERED_FOLD_GATHER_MAX_BYTES``.  Native psum is the
+    speed-of-light reference at each size."""
+    import jax.numpy as jnp
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu import config
+    from mpi4torch_tpu.ops import spmd
+
+    results = []
+    for log2_bytes in ((18, 21, 24, 27) if _on_tpu() else (16, 18)):
+        nelem = (1 << log2_bytes) // 4
+        x = jnp.ones((nelem,), jnp.float32)
+        point = {"bytes": nelem * 4}
+        step = mpi.run_spmd(
+            lambda x: mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM), nranks=n)
+        point["psum_s"] = _timeit(step, x, iters=10)
+        saved_det = config.deterministic_reductions()
+        saved_thresh = spmd._ORDERED_FOLD_GATHER_MAX_BYTES
+        config.set_deterministic_reductions(True)
+        try:
+            for mode, thresh in (("gather_fold", 1 << 62), ("ring_fold", 0)):
+                spmd._ORDERED_FOLD_GATHER_MAX_BYTES = thresh
+                step = mpi.run_spmd(
+                    lambda x: mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM),
+                    nranks=n)
+                point[f"{mode}_s"] = _timeit(step, x, iters=10)
+        finally:
+            config.set_deterministic_reductions(saved_det)
+            spmd._ORDERED_FOLD_GATHER_MAX_BYTES = saved_thresh
+        point["ring_vs_gather"] = point["ring_fold_s"] / point["gather_fold_s"]
+        _note(f"ordered fold {point['bytes']}B: gather "
+              f"{point['gather_fold_s']:.2e}s ring {point['ring_fold_s']:.2e}s "
+              f"psum {point['psum_s']:.2e}s")
+        results.append(point)
+    return results
+
+
 def bench_reduce_scatter(n):
     """Reduce_scatter vs Allreduce-then-slice (the ZeRO gradient path;
     parallel/zero.py).  On a multi-chip mesh the native psum_scatter is
@@ -181,6 +221,7 @@ def main():
     for name, fn in (("bcast_crossover", bench_bcast_crossover),
                      ("gather_cost", bench_gather_cost),
                      ("deterministic", bench_deterministic_overhead),
+                     ("ordered_fold_paths", bench_ordered_fold_paths),
                      ("reduce_scatter", bench_reduce_scatter)):
         try:
             result[name] = fn(n)

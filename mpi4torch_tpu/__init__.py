@@ -50,7 +50,7 @@ from .runtime import (
     run_ranks,
 )
 from .mesh import device_mesh, hybrid_mesh
-from .ops.spmd import RankExpr, p2p_scope, run_spmd
+from .ops.spmd import PermRank, RankExpr, p2p_scope, run_spmd
 from .distributed import (
     DistributedInfo,
     distributed_info,
@@ -96,6 +96,7 @@ __all__ = [
     "is_distributed",
     "local_values",
     "RankExpr",
+    "PermRank",
     "config",
     "CommError",
     "CollectiveMismatchError",

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Unattended TPU-evidence collector (VERDICT r4 item 1: make the on-chip
+# proof un-losable).  Loops a cheap device probe until the TPU tunnel is
+# reachable, then immediately runs the full hardware pipeline —
+#   1. make tpu-test          (the compiled-Pallas kernel tests)
+#   2. python bench.py        (BASELINE.md headline metrics)
+#   3. python bench_tradeoffs.py  (perf-constant calibration sweeps)
+# — teeing raw logs + timestamps into TPU_EVIDENCE/ so a later tunnel
+# outage cannot erase the proof.  Exits 0 once evidence is on disk.
+#
+# Usage: tools/tpu_evidence.sh [max_hours]   (default 11)
+set -u
+cd "$(dirname "$0")/.."
+MAX_HOURS="${1:-11}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+EV=TPU_EVIDENCE
+mkdir -p "$EV"
+
+probe() {
+    JAX_PLATFORMS=tpu timeout 180 python - <<'EOF' >"$EV/probe_last.log" 2>&1
+import jax, time
+t0 = time.time()
+ds = jax.devices()
+assert ds and ds[0].platform == "tpu", ds
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print("tpu ok:", ds, "init_s:", round(time.time() - t0, 1))
+EOF
+}
+
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    n=$((n + 1))
+    if probe; then
+        echo "probe $n succeeded at $(date -u +%FT%TZ)" | tee "$EV/00_probe.log"
+        cat "$EV/probe_last.log" >>"$EV/00_probe.log"
+
+        echo "=== make tpu-test @ $(date -u +%FT%TZ) ===" >"$EV/01_tpu_test.log"
+        MPI4TORCH_TPU_REAL_DEVICES=1 timeout 3600 \
+            python -m pytest tests/test_flash.py -q -rs \
+            -k "Compiled or Pallas or LanePadding" \
+            >>"$EV/01_tpu_test.log" 2>&1
+        echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/01_tpu_test.log"
+
+        echo "=== bench.py @ $(date -u +%FT%TZ) ===" >"$EV/02_bench.log"
+        timeout 5400 python bench.py >>"$EV/02_bench.log" 2>&1
+        echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/02_bench.log"
+
+        echo "=== bench_tradeoffs.py @ $(date -u +%FT%TZ) ===" >"$EV/03_tradeoffs.log"
+        timeout 5400 python bench_tradeoffs.py >>"$EV/03_tradeoffs.log" 2>&1
+        echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/03_tradeoffs.log"
+
+        echo "evidence collected at $(date -u +%FT%TZ)" >"$EV/DONE"
+        exit 0
+    fi
+    echo "probe $n failed at $(date -u +%FT%TZ)" >>"$EV/probe_history.log"
+    sleep 420
+done
+echo "deadline reached without a reachable TPU at $(date -u +%FT%TZ)" \
+    >>"$EV/probe_history.log"
+exit 1
