@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from . import constants as C
 from .ops import eager as _eager
@@ -180,16 +181,33 @@ class MPI_Communicator:
         return self._backend().reduce_(tensor, op, root)
 
     @_named_op
-    def Gather(self, tensor, gatheraxis: int, root: int):
+    def Gather(self, tensor, gatheraxis: int, root: int, numelem=None):
         """Concatenate per-rank tensors along ``gatheraxis`` on ``root``;
         per-rank axis lengths may differ (reference: src/__init__.py:212-213,
-        csrc/extension.cpp:497-599)."""
+        csrc/extension.cpp:497-599).
+
+        The eager backend reads each rank's length from its concrete
+        shape.  Under SPMD static shapes, pass ``numelem`` as a per-rank
+        tuple instead: the axis is capacity-padded, rank ``r``'s first
+        ``numelem[r]`` entries are valid, and the result comes back packed
+        to ``sum(numelem)`` (ops/packed.py; works on both backends)."""
+        if numelem is not None:
+            from .ops.packed import packed_gather
+            if isinstance(numelem, (int, _np.integer)):
+                numelem = (int(numelem),) * self.size   # uniform prefix
+            return packed_gather(self, tensor, gatheraxis, numelem, root)
         return self._backend().gather(tensor, gatheraxis, root)
 
     @_named_op
-    def Allgather(self, tensor, gatheraxis: int):
+    def Allgather(self, tensor, gatheraxis: int, numelem=None):
         """Gather with the result on every rank (reference:
-        src/__init__.py:215-216, csrc/extension.cpp:633-734)."""
+        src/__init__.py:215-216, csrc/extension.cpp:633-734).  Per-rank
+        tuple ``numelem``: see :meth:`Gather`."""
+        if numelem is not None:
+            from .ops.packed import packed_allgather
+            if isinstance(numelem, (int, _np.integer)):
+                numelem = (int(numelem),) * self.size   # uniform prefix
+            return packed_allgather(self, tensor, gatheraxis, numelem)
         return self._backend().allgather(tensor, gatheraxis)
 
     @_named_op
@@ -204,19 +222,44 @@ class MPI_Communicator:
         return self._backend().reduce_scatter(tensor, op, scatteraxis)
 
     @_named_op
-    def Scatter(self, tensor, scatteraxis: int, numelem: int, root: int):
+    def Scatter(self, tensor, scatteraxis: int, numelem, root: int):
         """Split ``root``'s tensor along ``scatteraxis``; this rank keeps
         ``numelem`` entries.  Non-root input shapes are ignored (reference:
-        src/__init__.py:218-219, csrc/extension.cpp:769-884)."""
-        return self._backend().scatter(tensor, scatteraxis, numelem,
+        src/__init__.py:218-219, csrc/extension.cpp:769-884).
+
+        ``numelem`` may be a per-rank tuple (the reference's per-receiver-
+        varying counts, csrc/extension.cpp:819-871): the axis must be the
+        packed ``sum(numelem)``; the result is capacity-padded to
+        ``max(numelem)`` with invalid slots zeroed (ops/packed.py; works
+        on both backends, incl. the SPMD mesh path)."""
+        if not isinstance(numelem, (int, _np.integer)):
+            from .ops.packed import packed_scatter
+            return packed_scatter(self, tensor, scatteraxis, numelem, root)
+        return self._backend().scatter(tensor, scatteraxis, int(numelem),
                                        root)
 
     @_named_op
-    def Alltoall(self, tensor, gatheraxis: int, scatteraxis: int, numelem: int):
+    def Alltoall(self, tensor, gatheraxis: int, scatteraxis: int, numelem,
+                 current_numelem=None):
         """Combined gather/redistribute (reference: src/__init__.py:221-223,
-        csrc/extension.cpp:917-987)."""
+        csrc/extension.cpp:917-987).
+
+        ``numelem`` may be a per-rank tuple (the reference's varying
+        segment sizes): gather axis capacity-padded in, packed out;
+        scatter axis packed in, capacity-padded+masked out.  For
+        ``gatheraxis == scatteraxis`` (the reference's interval-overlap
+        redistribution, csrc/extension.cpp:947-979) also pass
+        ``current_numelem``, the present partition — static traces cannot
+        read it off a padded shape (ops/packed.py)."""
+        if not isinstance(numelem, (int, _np.integer)):
+            from .ops.packed import packed_alltoall
+            return packed_alltoall(self, tensor, gatheraxis, scatteraxis,
+                                   numelem, current_numelem)
+        if current_numelem is not None:
+            raise ValueError(
+                "current_numelem only applies to per-rank tuple numelem")
         return self._backend().alltoall(tensor, gatheraxis, scatteraxis,
-                                        numelem)
+                                        int(numelem))
 
     # ------------------------------------------------------------------ p2p
 

@@ -393,17 +393,46 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
     x = params["embed"][tokens]
     if not cfg.rope:
         x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)[0]
+
+    # Sliding-window serving win: with attn_window set, the query only
+    # sees its last `window` positions, so attention runs on a
+    # position-tracking STATIC slice of the cache (power-of-two bucket
+    # >= window, one compiled program for all steps) instead of the full
+    # max_seq buffer — each decoded token costs O(window), not
+    # O(max_seq).  Without a window the full buffer is the visible set.
+    win = cfg.attn_window
+    bucket = cfg.max_seq
+    if win:
+        bucket = 1
+        while bucket < win:
+            bucket *= 2
+        bucket = min(bucket, cfg.max_seq)
+
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
         y = _norm(cfg, x, blk["ln1"])
         q, k_new, v_new = _split_qkv(cfg, blk, y[:, None, :], pos[None])
-        ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, pos, 1)
-        cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v_new, pos, 1)
+        # The cache dtype is authoritative (it may be an override, e.g. a
+        # bf16 serving cache under f32 params — ADVICE r4): cast the
+        # projected k/v to it before the in-place update.
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            c["k"], k_new.astype(c["k"].dtype), pos, 1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            c["v"], v_new.astype(c["v"].dtype), pos, 1)
         new_cache.append({"k": ck, "v": cv})
+        if bucket < cfg.max_seq:
+            # Earliest slice start that still covers [pos-win+1, pos];
+            # in-window masking inside the kernel does the rest.
+            start = jnp.clip(pos - bucket + 1, 0, cfg.max_seq - bucket)
+            kk = jax.lax.dynamic_slice_in_dim(ck, start, bucket, 1)
+            vv = jax.lax.dynamic_slice_in_dim(cv, start, bucket, 1)
+            kv_off = start
+        else:
+            kk, vv, kv_off = ck, cv, 0
         o, _ = flash_block_attention(
-            q, ck, cv, causal=True, q_offset=pos, kv_offset=0,
-            window=cfg.attn_window, impl="jnp")
-        x = x + o.reshape(b, cfg.d_model) @ blk["wo"]
+            q, kk, vv, causal=True, q_offset=pos, kv_offset=kv_off,
+            window=win, impl="jnp")
+        x = x + o.reshape(b, cfg.d_model).astype(x.dtype) @ blk["wo"]
         x, _ = _ffn_residual(cfg, blk, x, None)
     x = _norm(cfg, x, params["ln_f"])
     return x @ params["unembed"], new_cache
@@ -423,8 +452,14 @@ def prefill(cfg: TransformerConfig, params, cache, prompt):
         y = _norm(cfg, x, blk["ln1"])
         q, k, v = _split_qkv(cfg, blk, y,
                              jnp.arange(p_len, dtype=jnp.int32))
-        ck = jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, 1)
-        cv = jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0, 1)
+        # Cache dtype is authoritative (possible serving override; see
+        # decode_step) — attention itself runs on the params-dtype k/v
+        # of this very pass, so prefill logits are unaffected by a
+        # lower-precision cache.
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            c["k"], k.astype(c["k"].dtype), 0, 1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            c["v"], v.astype(c["v"].dtype), 0, 1)
         new_cache.append({"k": ck, "v": cv})
         o = flash_attention(q, k, v, causal=True, window=cfg.attn_window)
         x = x + o.reshape(b, p_len, cfg.d_model) @ blk["wo"]

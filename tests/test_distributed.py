@@ -86,6 +86,71 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+_HYBRID_WORKER = textwrap.dedent("""
+    import sys, os
+    # 4 virtual devices per process -> 2 processes x 4 = 8 global devices,
+    # 2 REAL granules (the process boundary is the CPU harness's DCN).
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import mpi4torch_tpu as mpi
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    info = mpi.init_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n, process_id=pid)
+    assert info.n_devices == 8, info
+
+    # VERDICT r4 item 6: hybrid_mesh with n_granules > 1 — the
+    # dcn-axes-outermost layout logic (mesh.py) on real granules.
+    m = mpi.hybrid_mesh({"tp": 4}, {"dp": 2})
+    assert m.axis_names == ("dp", "tp"), m.axis_names
+    devs = m.devices
+    assert devs.shape == (2, 4), devs.shape
+    # The layout contract: tp rows stay inside one process (ICI tier),
+    # the dp axis crosses the process boundary (DCN tier).
+    row_procs = [ {d.process_index for d in row} for row in devs ]
+    assert all(len(s) == 1 for s in row_procs), row_procs
+    assert row_procs[0] != row_procs[1], row_procs
+
+    ctp = mpi.comm_from_mesh(m, "tp")
+    cdp = mpi.comm_from_mesh(m, "dp")
+    assert ctp.size == 4 and cdp.size == 2
+
+    def body():
+        tp_sum = ctp.Allreduce(jnp.asarray(ctp.rank + 1.0), mpi.MPI_SUM)
+        dp_sum = cdp.Allreduce(jnp.asarray(cdp.rank + 1.0), mpi.MPI_SUM)
+        return tp_sum, dp_sum
+
+    tp_sum, dp_sum = jax.jit(shard_map(
+        body, mesh=m, in_specs=(), out_specs=(P(), P()),
+        check_vma=False))()
+    # tp: 1+2+3+4 within each granule; dp: 1+2 ACROSS the two processes
+    # (the value itself proves the collective crossed the boundary).
+    np.testing.assert_array_equal(np.asarray(tp_sum), 10.0)
+    np.testing.assert_array_equal(np.asarray(dp_sum), 3.0)
+
+    # And a gradient through the dp-axis collective (adjoint also DCN).
+    def loss():
+        x = (jnp.asarray(cdp.rank) + 1.0) * jnp.ones((2,))
+        def inner(x):
+            return jnp.vdot(cdp.Allreduce(x, mpi.MPI_SUM), jnp.ones((2,)))
+        return jax.grad(inner)(x)
+
+    g = jax.jit(shard_map(loss, mesh=m, in_specs=(), out_specs=P(),
+                          check_vma=False))()
+    np.testing.assert_array_equal(np.asarray(g), 2.0)
+
+    mpi.finalize_distributed()
+    print(f"HYBRID-WORKER-{pid}-OK", flush=True)
+""")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -125,6 +190,35 @@ class TestTwoProcessIntegration:
         for pid, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {pid} failed:\n{out}"
             assert f"WORKER-{pid}-OK" in out
+
+
+class TestHybridMeshMultiGranule:
+    def test_two_process_hybrid_mesh_dp_over_dcn(self, tmp_path):
+        script = tmp_path / "hybrid_worker.py"
+        script.write_text(_HYBRID_WORKER)
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)   # worker sets its own 4-device count
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(pid), "2", str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env)
+            for pid in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("2-process hybrid run timed out\n" + "\n".join(outs))
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+            assert f"HYBRID-WORKER-{pid}-OK" in out
 
 
 _MPI4PY_WORKER = textwrap.dedent("""

@@ -615,6 +615,32 @@ class TestDecoding:
         with pytest.raises(ValueError, match="top_k"):
             T.generate(cfg, params, prompt, n_new=2, top_k=cfg.vocab + 1)
 
+    def test_cache_dtype_override_mixed_precision(self):
+        # ADVICE r4 (medium): a bf16 serving cache under f32 params must
+        # work — decode_step/prefill cast projected k/v to the cache
+        # dtype.  Greedy tokens should also agree with the full-precision
+        # cache at this tiny config (logit gaps >> bf16 cache rounding;
+        # checked, not assumed — a mismatch would fail loudly here).
+        cfg = CFG
+        params = T.init_transformer(jax.random.PRNGKey(2), cfg,
+                                    dtype=jnp.float32)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0,
+                                    cfg.vocab)
+        out_bf16 = T.generate(cfg, params, prompt, n_new=6,
+                              dtype=jnp.bfloat16)
+        assert out_bf16.shape == (2, 10)
+        out_f32 = T.generate(cfg, params, prompt, n_new=6)
+        np.testing.assert_array_equal(np.asarray(out_bf16),
+                                      np.asarray(out_f32))
+        # The override must actually reach the cache storage.
+        cache = T.init_kv_cache(cfg, 2, jnp.bfloat16)
+        _, cache = T.prefill(cfg, params, cache, prompt)
+        assert cache[0]["k"].dtype == jnp.bfloat16
+        logits, cache = T.decode_step(cfg, params, cache,
+                                      prompt[:, -1], 4)
+        assert cache[0]["k"].dtype == jnp.bfloat16
+        assert logits.dtype == jnp.float32
+
     def test_decode_step_concrete_overflow_raises(self):
         # Past max_seq the dynamic slice would CLAMP (silently reusing
         # the last positional row and cache slot); concrete positions
