@@ -110,6 +110,27 @@ def test_isend_recv_wait(nranks):
         assert grad[0] == 2.0
 
 
+@pytest.mark.parametrize("nranks", [4, 8])
+def test_variable_token_exchange(nranks):
+    # Butterfly p2p + ragged repartition demo (examples docstring): the
+    # span contents, padding zeros, and per-rank gradient oracle are the
+    # example's own asserts; run its __main__ under both rank counts.
+    import subprocess
+    import sys as _sys
+
+    import os as _os
+
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = (str(EXAMPLES.parent) + _os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [_sys.executable, str(EXAMPLES / "variable_token_exchange.py"),
+         str(nranks)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK:" in proc.stdout
+
+
 def test_checkpoint_resume(tmp_path):
     # Preempted-then-resumed DP training must equal the uninterrupted
     # run bit-for-bit (the example asserts this internally too).
