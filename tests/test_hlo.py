@@ -145,6 +145,32 @@ class TestForwardCensus:
         got = census(ring, SMALL)
         assert got == only(collective_permute=1)
 
+    def test_butterfly_pair_fuses_into_one_collective_permute(self):
+        # General static permutations (rank ^ k) compile exactly like
+        # ring shifts: one collective_permute per matched pair, also in
+        # a user-managed shard_map region (comm_from_mesh + p2p_scope).
+        def butterfly(c, a):
+            h = c.Isend(a, c.rank ^ 1, 0)
+            b = c.Recv(mpi.JoinDummies(jnp.empty_like(a), [h.dummy]),
+                       c.rank ^ 1, 0)
+            w = c.Wait(mpi.JoinDummiesHandle(h, [b]))
+            return mpi.JoinDummies(b, [w])
+
+        got = census(butterfly, SMALL)
+        assert got == only(collective_permute=1)
+
+    def test_self_send_emits_no_collective(self):
+        # Identity permutation = local hand-off; nothing on the wire.
+        def selfsend(c, a):
+            h = c.Isend(a, c.rank, 0)
+            b = c.Recv(mpi.JoinDummies(jnp.empty_like(a), [h.dummy]),
+                       c.rank, 0)
+            w = c.Wait(mpi.JoinDummiesHandle(h, [b]))
+            return mpi.JoinDummies(b, [w])
+
+        got = census(selfsend, SMALL)
+        assert got == only()
+
 
 class TestAdjointCensus:
     def test_allreduce_fwd_bwd_is_two_all_reduce(self):

@@ -37,10 +37,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         cat "$EV/probe_last.log" >>"$EV/00_probe.log"
 
         echo "=== make tpu-test @ $(date -u +%FT%TZ) ===" >"$EV/01_tpu_test.log"
-        MPI4TORCH_TPU_REAL_DEVICES=1 timeout 3600 \
-            python -m pytest tests/test_flash.py -q -rs \
-            -k "Compiled or Pallas or LanePadding" \
-            >>"$EV/01_tpu_test.log" 2>&1
+        timeout 3600 make tpu-test >>"$EV/01_tpu_test.log" 2>&1
         echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/01_tpu_test.log"
 
         echo "=== bench.py @ $(date -u +%FT%TZ) ===" >"$EV/02_bench.log"
@@ -52,6 +49,35 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/03_tradeoffs.log"
 
         echo "evidence collected at $(date -u +%FT%TZ)" >"$EV/DONE"
+
+        # Summarize into the committed artifact (VERDICT r4 item 1:
+        # raw logs + timestamps as TPU_EVIDENCE.md, un-losable).
+        {
+            echo "# TPU evidence — round 5"
+            echo
+            echo "Collected unattended by tools/tpu_evidence.sh the moment"
+            echo "the tunnel came up.  Raw logs in TPU_EVIDENCE/."
+            echo
+            echo "## Probe"
+            echo '```'
+            cat "$EV/00_probe.log"
+            echo '```'
+            echo
+            echo "## make tpu-test (compiled Pallas kernel tests)"
+            echo '```'
+            tail -n 25 "$EV/01_tpu_test.log"
+            echo '```'
+            echo
+            echo "## bench.py (headline JSON = last line)"
+            echo '```'
+            tail -n 5 "$EV/02_bench.log"
+            echo '```'
+            echo
+            echo "## bench_tradeoffs.py"
+            echo '```'
+            tail -n 60 "$EV/03_tradeoffs.log"
+            echo '```'
+        } >"TPU_EVIDENCE.md"
         exit 0
     fi
     echo "probe $n failed at $(date -u +%FT%TZ)" >>"$EV/probe_history.log"
