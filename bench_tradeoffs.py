@@ -166,6 +166,76 @@ def bench_ordered_fold_paths(n):
     return results
 
 
+def bench_flash_tiling(n):
+    """Sweep the Pallas flash kernels' Q/KV tile sizes at the bench shape
+    — the first knob to turn if the head-to-head `flash_reference_ratio`
+    lands under 1.0 on chip.  Every point is oracle-checked against the
+    jnp reference before it is timed (a mis-lowering must never be
+    reported as a fast configuration); failures degrade to error stanzas.
+    On CPU the sweep is a harness smoke over the jnp path only."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4torch_tpu.ops import flash
+
+    if _on_tpu():
+        b, s, h, d, dtype, iters = 4, 4096, 8, 128, jnp.bfloat16, 10
+        sweep = [(128, 128), (256, 128), (512, 128),
+                 (128, 256), (256, 256), (512, 512)]
+        impl, tol = "pallas", 2e-2
+    else:
+        b, s, h, d, dtype, iters = 1, 256, 2, 64, jnp.float32, 2
+        sweep = [(128, 128), (256, 256)]
+        impl, tol = "jnp", 1e-5
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), dtype) for kk in keys)
+
+    def loss_of(which):
+        return lambda q, k, v: jnp.sum(flash.flash_attention(
+            q, k, v, causal=True, impl=which).astype(jnp.float32) ** 2)
+
+    ref = flash.flash_attention(q, k, v, causal=True, impl="jnp")
+    gref = jax.jit(jax.grad(loss_of("jnp"), argnums=(0, 1, 2)))(q, k, v)
+    results = []
+    saved = (flash._Q_TILE, flash._KV_TILE)
+    try:
+        for qt, kt in sweep:
+            flash._Q_TILE, flash._KV_TILE = qt, kt
+            point = {"q_tile": qt, "kv_tile": kt}
+            try:
+                out = flash.flash_attention(q, k, v, causal=True, impl=impl)
+                err = float(jnp.max(jnp.abs(
+                    out.astype(jnp.float32) - ref.astype(jnp.float32))))
+                # The timed program is fwd+bwd, so the gate must check the
+                # GRADIENTS too — a mis-lowered backward (the path the
+                # wide-tile _stat_tile branch feeds) must never be
+                # reported as a fast configuration.
+                g = jax.jit(jax.grad(loss_of(impl),
+                                     argnums=(0, 1, 2)))(q, k, v)
+                gerr = max(float(jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - b.astype(jnp.float32))))
+                    for a, b in zip(g, gref))
+                # Grad entries scale with the loss's 2*out factor; give
+                # the same relative budget an order of magnitude slack.
+                if err > tol or gerr > 50 * tol:
+                    raise AssertionError(
+                        f"tile ({qt},{kt}) wrong: fwd diff {err}, "
+                        f"grad diff {gerr}")
+                step = jax.jit(jax.value_and_grad(
+                    loss_of(impl), argnums=(0, 1, 2)))
+                point["fwd_bwd_s"] = _timeit(step, q, k, v, iters=iters)
+                point["max_abs_diff_vs_jnp"] = err
+                point["max_grad_diff_vs_jnp"] = gerr
+            except Exception as e:  # noqa: BLE001 — per-point guard
+                point["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+            results.append(point)
+            _note(f"flash tiling {qt}x{kt}: {point}")
+    finally:
+        flash._Q_TILE, flash._KV_TILE = saved
+    return results
+
+
 def bench_reduce_scatter(n):
     """Reduce_scatter vs Allreduce-then-slice (the ZeRO gradient path;
     parallel/zero.py).  On a multi-chip mesh the native psum_scatter is
@@ -222,6 +292,7 @@ def main():
                      ("gather_cost", bench_gather_cost),
                      ("deterministic", bench_deterministic_overhead),
                      ("ordered_fold_paths", bench_ordered_fold_paths),
+                     ("flash_tiling", bench_flash_tiling),
                      ("reduce_scatter", bench_reduce_scatter)):
         try:
             result[name] = fn(n)

@@ -359,11 +359,18 @@ def _pallas_block(q, k, v, q_off, kv_off, causal: bool, interpret: bool,
 
 
 def _stat_tile(x, width: int):
-    """Narrow a (rows, _STAT_LANES) lane-broadcast statistic to (rows,
-    width) without relayout.  Both callers pass width = min(128, seq), so
-    width is exactly _STAT_LANES or smaller — a lane-0 slice covers the
-    short case (every lane holds the same value)."""
-    return x if width == _STAT_LANES else x[:, :width]
+    """Resize a (rows, _STAT_LANES) lane-broadcast statistic to (rows,
+    width) without relayout.  Every lane holds the same value, so
+    narrower widths are a leading-lane slice and wider widths (KV tiles
+    above 128 — the tunable `_KV_TILE`, swept by bench_tradeoffs.py
+    flash_tiling) are a relayout-free lane-tiling concat of the
+    already-broadcast slab."""
+    if width == _STAT_LANES:
+        return x
+    if width < _STAT_LANES:
+        return x[:, :width]
+    reps = -(-width // _STAT_LANES)
+    return jnp.concatenate([x] * reps, axis=1)[:, :width]
 
 
 def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
@@ -660,8 +667,12 @@ def _probe_compiles(cache, fn, extra_args, label, sq, sk, d, dtype,
     changes loop bounds/masking; the window LENGTH is loop arithmetic
     with no lowering effect, so one probe covers every positive value) —
     so a batch/head-reduced instance (q heads = g, one KV head; tiny
-    grid) proves lowering for the whole family."""
-    key = (sq, sk, d, jnp.dtype(dtype).name, causal, g, bool(window))
+    grid) proves lowering for the whole family.  The tunable tile sizes
+    (module globals, swept by bench_tradeoffs.py flash_tiling) are part
+    of the key: a verdict probed under one tiling must not be reused
+    after the tiles change."""
+    key = (sq, sk, d, jnp.dtype(dtype).name, causal, g, bool(window),
+           _Q_TILE, _KV_TILE)
     ok = cache.get(key)
     if ok is None:
         import warnings

@@ -202,6 +202,50 @@ class TestPallasBackwardKernel:
             np.testing.assert_array_equal(np.asarray(a), 0.0)
 
 
+class TestTunableTiles:
+    """Non-default _Q_TILE/_KV_TILE configurations (the knobs
+    bench_tradeoffs.py flash_tiling sweeps on chip) must stay
+    oracle-correct, forward AND backward — KV tiles wider than the
+    128-lane stat slab exercise _stat_tile's lane-tiling branch."""
+
+    @pytest.mark.parametrize("qt,kt", [(256, 128), (256, 256),
+                                       (512, 512), (128, 256)])
+    def test_tiles_match_jnp_fwd_bwd(self, qt, kt, monkeypatch):
+        monkeypatch.setattr(flash, "_Q_TILE", qt)
+        monkeypatch.setattr(flash, "_KV_TILE", kt)
+        q, k, v = qkv((1, 512, 2, 64), dtype=jnp.float32, seed=5)
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(flash.flash_attention(
+                q, k, v, causal=True, impl=impl) ** 2)
+
+        out_p = flash.flash_attention(q, k, v, causal=True, impl="pallas")
+        out_j = flash.flash_attention(q, k, v, causal=True, impl="jnp")
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_j),
+                                   rtol=1e-5, atol=1e-6)
+        gp = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gj = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gj):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_windowed_gqa_at_wide_tiles(self, monkeypatch):
+        monkeypatch.setattr(flash, "_Q_TILE", 256)
+        monkeypatch.setattr(flash, "_KV_TILE", 256)
+        q, _, _ = qkv((1, 512, 4, 64), dtype=jnp.float32, seed=7)
+        _, k, v = qkv((1, 512, 2, 64), dtype=jnp.float32, seed=8)
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(flash.flash_attention(
+                q, k, v, causal=True, window=100, impl=impl) ** 2)
+
+        gp = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gj = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gj):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+
 class TestLanePadding:
     """head_dim 64/96 take the kernel via zero-padding to the 128 lane
     width (round-1 gap: the common d=64 silently fell back to jnp)."""
