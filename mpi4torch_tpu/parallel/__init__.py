@@ -32,7 +32,8 @@ from .tp import (
     tp_mlp,
 )
 from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
-from .zero import shard_global_norm, zero_init, zero_step
+from .zero import (shard_global_norm, zero3_init, zero3_params,
+                   zero3_shard_params, zero3_step, zero_init, zero_step)
 from .pp import (pipeline_spmd, pipeline_step, pipeline_step_1f1b,
                  pipeline_step_interleaved,
                  recv_activation, schedule_1f1b, send_activation)
@@ -42,6 +43,10 @@ __all__ = [
     "shard_global_norm",
     "zero_init",
     "zero_step",
+    "zero3_init",
+    "zero3_params",
+    "zero3_shard_params",
+    "zero3_step",
     "attention",
     "dp",
     "moe",

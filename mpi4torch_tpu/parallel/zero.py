@@ -1,4 +1,5 @@
-"""ZeRO-1: optimizer states sharded over the data-parallel axis.
+"""ZeRO-1 and ZeRO-3: optimizer states (and, for stage 3, the parameters
+themselves) sharded over the data-parallel axis.
 
 Plain DP replicates parameters, gradients AND optimizer state on every
 rank; with Adam the state is 2x the parameter bytes, so at scale the
@@ -82,9 +83,7 @@ def shard_global_norm(comm, shards):
 def zero_init(comm, opt, params):
     """Optimizer state for this rank's parameter shards: ``opt.init`` on
     the sharded-and-padded view — ``1/size`` of the replicated state."""
-    shards = jax.tree.map(
-        lambda p: _my_shard(comm, _pad_flat(p, comm.size)), params)
-    return opt.init(shards)
+    return opt.init(zero3_shard_params(comm, params))
 
 
 def zero_step(comm, opt, params, local_grads, opt_state,
@@ -113,14 +112,84 @@ def zero_step(comm, opt, params, local_grads, opt_state,
     g_shards = jax.tree.map(grad_shard, local_grads)
     if grad_transform is not None:
         g_shards = grad_transform(g_shards)
-    p_shards = jax.tree.map(
-        lambda p: _my_shard(comm, _pad_flat(p, size)), params)
+    p_shards = zero3_shard_params(comm, params)
     updates, new_state = opt.update(g_shards, opt_state, p_shards)
     p_shards = jax.tree.map(jnp.add, p_shards, updates)
+    return zero3_params(comm, p_shards, params), new_state
 
-    def regather(shard, p):
+
+# ---------------------------------------------------------------------------
+# ZeRO-3: parameters sharded between steps, gathered on use
+# ---------------------------------------------------------------------------
+#
+# Stage 3 of the ZeRO partitioning also shards the PARAMETERS: between
+# steps each rank persists only its 1/size flat shard (parameter HBM
+# drops by size×, on top of stage 1's optimizer-state saving), and the
+# full parameters exist only transiently inside the step.
+#
+# The whole stage falls out of the AD-transparent Allgather: the forward
+# gathers shards into full parameters, and because Allgather's adjoint
+# is the reduce-scatter (ops/spmd.py:allgather — the mathematically
+# correct adjoint the reference got wrong at csrc/extension.cpp:627),
+# ``jax.grad`` of the local loss w.r.t. the SHARDS automatically yields
+# each rank's segment of the rank-SUMMED global gradient — ZeRO-3's
+# gather-params/reduce-scatter-grads wire pattern is literally the
+# forward/backward pair of one collective.  Per step the wire cost is
+# one allgather (params, forward) + one reduce-scatter (gradients,
+# backward) + one allgather (updated shards via zero3_params at the next
+# forward) — 1.5 ring allreduces, the canonical ZeRO-3 overhead.
+
+
+def zero3_shard_params(comm, params):
+    """Partition full parameters into this rank's flat shards (the
+    persistent between-step representation; pad-to-size flattening as in
+    stage 1).  Returns the shard tree; keep the original ``params`` tree
+    (or a ShapeDtypeStruct tree of it) as the shape template."""
+    return jax.tree.map(
+        lambda p: _my_shard(comm, _pad_flat(p, comm.size)), params)
+
+
+def zero3_params(comm, p_shards, template):
+    """Differentiable gather: full parameters from this rank's shards.
+    Inside ``jax.grad``, the adjoint reduce-scatters the parameter
+    cotangents back to shards — summing over ranks on the way, so the
+    gradient of a rank-local loss w.r.t. the shards IS the global-sum
+    gradient shard."""
+    def regather(shard, t):
         full = comm.Allgather(shard, 0)
-        return full[:p.size].reshape(p.shape)
+        return full[:t.size].reshape(t.shape).astype(t.dtype)
 
-    new_params = jax.tree.map(regather, p_shards, params)
-    return new_params, new_state
+    return jax.tree.map(regather, p_shards, template)
+
+
+def zero3_init(comm, opt, params):
+    """Shards + optimizer state over them: ``(p_shards, opt_state)``.
+    ``opt.init`` runs on the sharded view, exactly like :func:`zero_init`."""
+    p_shards = zero3_shard_params(comm, params)
+    return p_shards, opt.init(p_shards)
+
+
+def zero3_step(comm, opt, p_shards, template, local_loss_fn, opt_state,
+               grad_transform=None):
+    """One ZeRO-3 update; returns ``(loss, new_p_shards, new_opt_state)``.
+
+    ``local_loss_fn(full_params)`` is this rank's UN-reduced local loss
+    (no DP Allreduce inside — the reduction happens in the Allgather
+    adjoint).  The update divides the summed gradient by ``size`` to
+    match the plain-DP rank-mean convention, then applies ``opt`` on the
+    shards (element-wise optimizers reproduce the replicated trajectory
+    exactly, as in stage 1).  ``grad_transform`` hooks the sharded
+    global-mean gradients, same contract as :func:`zero_step` (use
+    :func:`shard_global_norm` for true global-norm clipping)."""
+    size = comm.size
+
+    def loss_of_shards(shards):
+        return local_loss_fn(zero3_params(comm, shards, template))
+
+    loss, g_shards = jax.value_and_grad(loss_of_shards)(p_shards)
+    g_shards = jax.tree.map(lambda g: g / size, g_shards)
+    if grad_transform is not None:
+        g_shards = grad_transform(g_shards)
+    updates, new_state = opt.update(g_shards, opt_state, p_shards)
+    new_shards = jax.tree.map(jnp.add, p_shards, updates)
+    return loss, new_shards, new_state

@@ -269,3 +269,111 @@ def test_zero_state_checkpoint_resume(tmp_path):
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-12),
             got, want)
+
+
+class TestZero3:
+    """ZeRO-3 (parallel/zero.py zero3_*): parameters persist as 1/size
+    flat shards between steps, gathered on use; the gradient arrives
+    sharded through the Allgather ADJOINT (the reduce-scatter), and the
+    trajectory must exactly match plain replicated DP."""
+
+    @pytest.mark.parametrize("make_opt", [
+        lambda: optax.adam(1e-1),
+        lambda: optax.sgd(1e-2, momentum=0.9),
+    ], ids=["adam", "sgd-momentum"])
+    def test_matches_replicated_oracle_eager(self, make_opt):
+        from mpi4torch_tpu.parallel import zero3_init, zero3_params, \
+            zero3_step
+        x, y, params0 = _data()
+        ref = _replicated_oracle(make_opt(), x, y, params0)
+        shard = N // NR
+
+        def body():
+            xl = x[comm.rank * shard:(comm.rank + 1) * shard]
+            yl = y[comm.rank * shard:(comm.rank + 1) * shard]
+            opt = make_opt()
+            p_shards, state = zero3_init(comm, opt, params0)
+            for _ in range(STEPS):
+                _, p_shards, state = zero3_step(
+                    comm, opt, p_shards, params0,
+                    lambda p: _local_loss(p, xl, yl), state)
+            return zero3_params(comm, p_shards, params0)
+
+        outs = mpi.run_ranks(body, NR)
+        for got in outs:
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-12),
+                got, ref)
+
+    def test_matches_replicated_oracle_spmd(self):
+        from mpi4torch_tpu.parallel import zero3_init, zero3_params, \
+            zero3_step
+        x, y, params0 = _data()
+        opt = optax.adam(1e-1)
+        ref = _replicated_oracle(opt, x, y, params0)
+        shard = N // NR
+
+        def body():
+            r = jnp.asarray(comm.rank)
+            xl = jax.lax.dynamic_slice_in_dim(x, r * shard, shard, 0)
+            yl = jax.lax.dynamic_slice_in_dim(y, r * shard, shard, 0)
+            p_shards, state = zero3_init(comm, opt, params0)
+            for _ in range(STEPS):
+                _, p_shards, state = zero3_step(
+                    comm, opt, p_shards, params0,
+                    lambda p: _local_loss(p, xl, yl), state)
+            return zero3_params(comm, p_shards, params0)
+
+        stacked = mpi.run_spmd(body, nranks=NR)()
+        for rank in range(NR):
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a)[rank], np.asarray(b), rtol=1e-9,
+                    atol=1e-12),
+                stacked, ref)
+
+    def test_everything_is_sharded(self):
+        from mpi4torch_tpu.parallel import zero3_init
+
+        def body():
+            opt = optax.adam(1e-1)
+            p = {"w": jnp.zeros((NR * 6,)), "m": jnp.zeros((3, 5))}
+            p_shards, state = zero3_init(comm, opt, p)
+            # Parameters AND Adam moments are shard-sized (padded:
+            # 15 -> ceil(15/4) = 4 per rank).
+            assert p_shards["w"].shape == (6,)
+            assert p_shards["m"].shape == (4,)
+            assert state[0].mu["w"].shape == (6,)
+            assert state[0].nu["m"].shape == (4,)
+            return True
+
+        assert all(mpi.run_ranks(body, NR))
+
+    def test_wire_pattern_hlo(self):
+        # ZeRO-3's canonical overhead: one step lowers to allgathers
+        # (params, forward) + reduce-scatters (gradient adjoint) — and
+        # crucially NO all_reduce (a full gradient allreduce would mean
+        # the sharding saved nothing on the wire).
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from mpi4torch_tpu.parallel import zero3_init, zero3_step
+
+        mesh = Mesh(np.asarray(jax.devices()[:NR]), ("z",))
+        c = mpi.comm_from_mesh(mesh, "z")
+        x, y, params0 = _data()
+        opt = optax.sgd(1e-2)
+
+        def body():
+            p_shards, state = zero3_init(c, opt, params0)
+            _, p_shards, state = zero3_step(
+                c, opt, p_shards, params0,
+                lambda p: _local_loss(p, x, y), state)
+            return jax.tree.leaves(p_shards)[0]
+
+        txt = jax.jit(shard_map(body, mesh=mesh, in_specs=(),
+                                out_specs=P(), check_vma=False)).lower()
+        txt = txt.as_text()
+        assert txt.count("stablehlo.all_gather") >= 1
+        assert txt.count("stablehlo.reduce_scatter") >= 1
+        assert txt.count("stablehlo.all_reduce") == 0, txt
