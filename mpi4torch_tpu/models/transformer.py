@@ -29,7 +29,7 @@ from ..parallel.attention import ring_attention, \
     ulysses_attention
 from ..parallel.dp import all_average_tree
 from ..parallel.moe import init_moe, moe_ffn, moe_ffn_dense
-from ..parallel.zero import zero_step
+from ..parallel.zero import zero3_step, zero_step
 from ..parallel.ring import ring_shift
 
 
@@ -690,6 +690,33 @@ def zero_train_step(cfg: TransformerConfig, params, tokens, opt,
     # Report the dp-global mean loss.
     loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
     return loss, new_params, new_state
+
+
+def zero3_train_step(cfg: TransformerConfig, p_shards, template, tokens,
+                     opt, opt_state, comm_dp, comm_sp=None,
+                     attn: str = "ring"):
+    """One optimizer step with ZeRO-3 over the dp axis: the parameters
+    live as 1/dp flat shards BETWEEN steps (parameter + optimizer HBM
+    both / dp); returns ``(loss, new_p_shards, new_opt_state)``.
+
+    The forward gathers shards on use (:func:`parallel.zero3_params`);
+    the backward reduce-scatters the gradients through the Allgather
+    adjoint — the dp reduction needs no explicit collective at all.
+    Sequence parallelism composes inside the local loss exactly as in
+    :func:`zero_train_step`.  Obtain ``(p_shards, opt_state)`` from
+    :func:`parallel.zero3_init` and full parameters for evaluation from
+    :func:`parallel.zero3_params`; trajectories match replicated-DP
+    optax training exactly (tests/test_transformer.py)."""
+
+    def local_loss(p):
+        if comm_sp is not None and comm_sp.size > 1:
+            p = all_average_tree(comm_sp, p)
+        return lm_loss(cfg, p, tokens, comm_sp, attn)
+
+    loss, new_shards, new_state = zero3_step(
+        comm_dp, opt, p_shards, template, local_loss, opt_state)
+    loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+    return loss, new_shards, new_state
 
 
 def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
