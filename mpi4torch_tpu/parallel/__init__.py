@@ -23,7 +23,9 @@ from . import attention, dp, moe, pp, ring, tp, zero
 
 from .dp import all_average_tree, dp_value_and_grad
 from .ring import halo_exchange, ring_shift
-from .attention import dense_attention, ring_attention, ulysses_attention
+from .attention import (dense_attention, ring_attention,
+                        ulysses_attention, zigzag_positions, zigzag_slice,
+                        zigzag_ring_attention)
 from .tp import (
     column_parallel_linear,
     row_parallel_linear,
@@ -59,6 +61,9 @@ __all__ = [
     "dense_attention",
     "ring_attention",
     "ulysses_attention",
+    "zigzag_positions",
+    "zigzag_slice",
+    "zigzag_ring_attention",
     "column_parallel_linear",
     "row_parallel_linear",
     "shard_axis",

@@ -116,6 +116,109 @@ def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,
     return out
 
 
+def zigzag_positions(size: int, s_local: int):
+    """Global positions of rank ``r``'s zigzag shard, as a numpy index
+    array of shape ``(size, s_local)``: chunk ``r`` followed by the
+    mirror chunk ``2*size - 1 - r``.  ``np.concatenate`` of rows in rank
+    order is the permutation that re-assembles the global sequence from
+    stacked per-rank outputs (see tests)."""
+    import numpy as np
+
+    c = s_local // 2
+    return np.stack([
+        np.concatenate([np.arange(r * c, (r + 1) * c),
+                        np.arange((2 * size - 1 - r) * c,
+                                  (2 * size - r) * c)])
+        for r in range(size)])
+
+
+def zigzag_slice(comm, x, axis: int = 1):
+    """This rank's zigzag shard of a replicated global-sequence tensor
+    (rank may be symbolic under SPMD: two dynamic slices).  The global
+    axis length must be ``2 * size`` equal chunks."""
+    size = comm.size
+    s_global = x.shape[axis]
+    if s_global % (2 * size) != 0:
+        raise ValueError(
+            f"zigzag layout needs the sequence ({s_global}) divisible "
+            f"into 2*size ({2 * size}) equal chunks")
+    c = s_global // (2 * size)
+    r = jnp.asarray(comm.rank)
+    lo = jax.lax.dynamic_slice_in_dim(x, r * c, c, axis)
+    hi = jax.lax.dynamic_slice_in_dim(x, (2 * size - 1 - r) * c, c, axis)
+    return jnp.concatenate([lo, hi], axis=axis)
+
+
+def zigzag_ring_attention(comm, q, k, v, tag: int = 0, impl: str = "auto"):
+    """Load-balanced CAUSAL ring attention (the zigzag layout of
+    zigzag/striped ring attention, PAPERS.md).
+
+    Plain :func:`ring_attention` with contiguous shards is causally
+    imbalanced: rank ``r``'s queries see only ``r+1`` of ``size`` KV
+    blocks, so the last rank does ~``size``× the first rank's work and
+    sets the wall clock (~2× the balanced optimum at large ``size``).
+    Here rank ``r`` owns global chunk ``r`` AND the mirror chunk
+    ``2*size-1-r`` (each ``s_local/2`` long): every rank's visible-key
+    total is identical by symmetry, so per-step compute is uniform
+    across ranks.
+
+    Inputs are the per-rank zigzag shards (:func:`zigzag_slice`); the
+    output is the attention result in the same layout — re-assemble with
+    the :func:`zigzag_positions` permutation.  K/V circulate the same
+    differentiable ring as :func:`ring_attention` (gradients ride the
+    reverse ring automatically); each arriving block contributes up to
+    three live (q-half, kv-half) pairs — ``lo→hi`` keys are always
+    entirely in the future of ``lo`` queries and are skipped statically,
+    not masked at runtime.
+    """
+    from ..ops.flash import flash_block_attention, merge_partials
+
+    size = comm.size
+    s_local = q.shape[1]
+    if s_local % 2:
+        raise ValueError(
+            f"zigzag shards hold two equal chunks; got odd s_local "
+            f"{s_local}")
+    c = s_local // 2
+    my_rank = jnp.asarray(comm.rank)
+
+    q_halves = (q[:, :c], q[:, c:])
+
+    def offs(owner):
+        return (owner * c, (2 * size - 1 - owner) * c)
+
+    q_offs = offs(my_rank)
+    acc = [None, None]   # (out, lse) per q half
+
+    for step in range(size):
+        if step + 1 < size:
+            k_next = ring_shift(comm, k, 1, tag + 2 * step)
+            v_next = ring_shift(comm, v, 1, tag + 2 * step + 1)
+        owner = (my_rank - step) % size
+        kv_offs = offs(owner)
+        kv_halves = ((k[:, :c], v[:, :c]), (k[:, c:], v[:, c:]))
+        for qi in range(2):
+            for ki in range(2):
+                if qi == 0 and ki == 1:
+                    # lo queries (< size*c) never see hi keys (>= size*c)
+                    # under causal masking, for ANY pair of ranks —
+                    # static skip, no launch, no wire.
+                    continue
+                kb, vb = kv_halves[ki]
+                o_b, lse_b = flash_block_attention(
+                    q_halves[qi], kb, vb, causal=True,
+                    q_offset=q_offs[qi], kv_offset=kv_offs[ki],
+                    impl=impl)
+                if acc[qi] is None:
+                    acc[qi] = (o_b, lse_b)
+                else:
+                    acc[qi] = merge_partials(*acc[qi], o_b, lse_b)
+        if step + 1 < size:
+            k, v = k_next, v_next
+
+    return jnp.concatenate([acc[0][0], acc[1][0]], axis=1)
+
+
 def ulysses_attention(comm, q, k, v, causal: bool = False,
                       impl: str = "auto", window: int = 0):
     """Ulysses sequence parallelism: all-to-all head<->sequence reshuffle.
