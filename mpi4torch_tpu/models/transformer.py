@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ..constants import MPI_SUM
 from ..ops.flash import flash_attention, flash_block_attention
 from ..parallel.attention import ring_attention, \
-    ulysses_attention
+    ulysses_attention, zigzag_ring_attention
 from ..parallel.dp import all_average_tree
 from ..parallel.moe import init_moe, moe_ffn, moe_ffn_dense
 from ..parallel.zero import zero3_step, zero_step
@@ -256,8 +256,20 @@ def _ffn_residual(cfg: TransformerConfig, blk, x, comm_ep):
         jnp.zeros((), x.dtype)
 
 
+def _zigzag_positions(comm_sp, s_local: int):
+    """Global positions of this rank's zigzag sequence shard (symbolic
+    rank safe) — by slicing the global position axis with the ONE
+    layout-defining helper, so the transformer's position/label math can
+    never drift from the data sharding in parallel/attention.py."""
+    from ..parallel.attention import zigzag_slice
+
+    return zigzag_slice(
+        comm_sp, jnp.arange(comm_sp.size * s_local, dtype=jnp.int32),
+        axis=0)
+
+
 def _attention(q, k, v, comm_sp, attn: str, window: int = 0):
-    if attn not in ("dense", "ring", "ulysses"):
+    if attn not in ("dense", "ring", "ulysses", "zigzag"):
         raise ValueError(f"unknown attention strategy {attn!r}")
     if comm_sp is None or comm_sp.size == 1:
         # The fused flash path: Pallas kernel on eligible TPU shapes
@@ -274,6 +286,15 @@ def _attention(q, k, v, comm_sp, attn: str, window: int = 0):
         )
     if attn == "ring":
         return ring_attention(comm_sp, q, k, v, causal=True, window=window)
+    if attn == "zigzag":
+        if window:
+            raise ValueError(
+                "attn='zigzag' does not compose with attn_window: a "
+                "sliding window already balances causal work (every "
+                "query sees the same key count), which is the whole "
+                "point of the zigzag layout — use attn='ring' for "
+                "windowed sequence parallelism")
+        return zigzag_ring_attention(comm_sp, q, k, v)
     return ulysses_attention(comm_sp, q, k, v, causal=True, window=window)
 
 
@@ -312,11 +333,22 @@ def forward(cfg: TransformerConfig, params, tokens, comm_sp=None,
         offset = jnp.asarray(comm_sp.rank) * s_local
     else:
         offset = 0
-    positions = offset + jnp.arange(s_local, dtype=jnp.int32)
+    zigzag_sharded = (attn == "zigzag" and comm_sp is not None
+                      and comm_sp.size > 1)
+    if zigzag_sharded:
+        # This rank's tokens are the ZIGZAG shard (chunk r + mirror
+        # chunk 2*sp-1-r; parallel.zigzag_slice produces it) — two
+        # global position intervals, not one.
+        positions = _zigzag_positions(comm_sp, s_local)
+    else:
+        positions = offset + jnp.arange(s_local, dtype=jnp.int32)
     x = params["embed"][tokens]
     if not cfg.rope:
-        x = x + jax.lax.dynamic_slice_in_dim(
-            params["pos"], offset, s_local, 0)[None]
+        if zigzag_sharded:
+            x = x + jnp.take(params["pos"], positions, axis=0)[None]
+        else:
+            x = x + jax.lax.dynamic_slice_in_dim(
+                params["pos"], offset, s_local, 0)[None]
     d = x.shape[-1]
     aux_total = jnp.zeros((), x.dtype)
 
@@ -616,14 +648,31 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
                       return_hidden=want_hidden)
         aux = None
 
-    if sp > 1:
+    if sp > 1 and attn == "zigzag":
+        # Zigzag shard = chunks (r, 2*sp-1-r).  Each chunk's last label
+        # is the FIRST token of the globally-next chunk: chunk r+1 is
+        # rank r+1's lo chunk (ring shift -1) except for the last rank,
+        # whose lo chunk is followed by its OWN hi chunk; chunk 2*sp-r
+        # is rank r-1's hi chunk (ring shift +1) — rank 0's hi chunk is
+        # the global tail, already masked below.  Both shifts appear in
+        # every rank's program (SPMD-symmetric), the where picks.
+        c = s_local // 2
+        lo, hi = tokens[:, :c], tokens[:, c:]
+        r = jnp.asarray(comm_sp.rank)
+        from_next_lo = ring_shift(comm_sp, lo[:, :1], shift=-1)
+        from_prev_hi = ring_shift(comm_sp, hi[:, :1], shift=1)
+        lo_last = jnp.where(r == sp - 1, hi[:, :1], from_next_lo)
+        labels = jnp.concatenate(
+            [lo[:, 1:], lo_last, hi[:, 1:], from_prev_hi], axis=1)
+        global_pos = _zigzag_positions(comm_sp, s_local)
+    elif sp > 1:
         nxt = ring_shift(comm_sp, tokens[:, :1], shift=-1)
         labels = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
-        offset = jnp.asarray(comm_sp.rank) * s_local
+        global_pos = jnp.asarray(comm_sp.rank) * s_local \
+            + jnp.arange(s_local)
     else:
         labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
-        offset = 0
-    global_pos = offset + jnp.arange(s_local)
+        global_pos = jnp.arange(s_local)
     mask = (global_pos < s_global - 1).astype(out.dtype)
 
     if want_hidden:

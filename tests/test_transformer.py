@@ -75,6 +75,74 @@ def test_2d_mesh_matches_single_process(attn, dp, sp):
         new_params, ref_params)
 
 
+def make_zigzag_mesh_step(cfg, dp, sp):
+    """Like make_mesh_step but tokens are sharded in the ZIGZAG layout
+    (chunk r + mirror chunk), the layout attn='zigzag' consumes."""
+    from mpi4torch_tpu.parallel import zigzag_slice
+
+    mesh = Mesh(np.asarray(jax.devices()[:dp * sp]).reshape(dp, sp),
+                ("dp", "sp"))
+    comm_dp = mpi.comm_from_mesh(mesh, "dp")
+    comm_sp = mpi.comm_from_mesh(mesh, "sp")
+    bl = B // dp
+
+    def shard_step(params, tokens):
+        rows = jax.lax.dynamic_slice_in_dim(
+            tokens, jnp.asarray(comm_dp.rank) * bl, bl, 0)
+        local = zigzag_slice(comm_sp, rows, axis=1)
+        return T.train_step(cfg, params, local, comm_sp=comm_sp,
+                            comm_dp=comm_dp, attn="zigzag")
+
+    return jax.jit(shard_map(shard_step, mesh=mesh, in_specs=P(),
+                             out_specs=P(), check_vma=False))
+
+
+class TestZigzagFlagship:
+    """attn='zigzag' through the full distributed step: the load-balanced
+    layout must reproduce the single-process run exactly — the boundary
+    labels cross chunk seams via two one-token ring shifts, and the
+    positional encoding follows the two global intervals."""
+
+    @pytest.mark.parametrize("dp,sp", [(2, 4), (1, 8)])
+    def test_2d_mesh_matches_single_process(self, dp, sp):
+        params, tokens = setup()
+        ref_loss, ref_params = reference_step(params, tokens)
+        loss, new_params = make_zigzag_mesh_step(CFG, dp, sp)(params,
+                                                              tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-12, atol=1e-14)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-11),
+            new_params, ref_params)
+
+    def test_rope_matches_single_process(self):
+        # Rope path: positions are computed (not table-indexed); the two
+        # zigzag intervals must rotate with their true global angles.
+        cfg = dataclasses.replace(CFG, rope=True)
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab)
+        ref_loss, ref_params = T.train_step(cfg, params, tokens)
+        loss, new_params = make_zigzag_mesh_step(cfg, 2, 4)(params, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-12, atol=1e-14)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-11),
+            new_params, ref_params)
+
+    def test_window_rejected(self):
+        cfg = dataclasses.replace(CFG, attn_window=5)
+        params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                    dtype=jnp.float64)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab)
+        with pytest.raises(ValueError, match="does not compose"):
+            make_zigzag_mesh_step(cfg, 1, 8)(params, tokens)
+
+
 def test_eager_sp_matches_single_process():
     params, tokens = setup()
     ref = float(T.lm_loss(CFG, params, tokens))
