@@ -443,9 +443,36 @@ def _bench_train_step(on_tpu: bool, peak: float):
 
     breakdown = _guarded("train_step.breakdown", _breakdown)
 
+    # Ground the hand accounting against the compiler's own count: XLA's
+    # cost analysis of the compiled step vs the 6*N*T model FLOPs.  Two
+    # opposite-signed deviations are expected: XLA additionally counts
+    # the flash recompute + optimizer arithmetic (ratio up), while 6*N*T
+    # charges the embedding table as if it were a matmul when the actual
+    # lookup is a gather (ratio down — dominant at small configs where
+    # the table is a large parameter share, e.g. 0.85 on the CPU smoke
+    # config).  A ratio far below the embedding share would mean the
+    # accounting — and therefore the MFU — is inflated.
+    def _xla_flops():
+        # step is already @jax.jit — lower it directly (cache-friendly,
+        # no redundant re-wrap/trace).
+        ca = step.lower(params, tokens).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        if "flops" not in ca:
+            raise KeyError(
+                f"no 'flops' in cost_analysis keys {sorted(ca)[:10]}")
+        return float(ca["flops"])
+
+    xla_flops = _guarded("train_step.xla_cost", _xla_flops)
+    if isinstance(xla_flops, dict):   # error stanza: count unavailable
+        xla_ratio = None
+    else:
+        xla_ratio = round(xla_flops / flops, 3) if flops else None
+
     return {
         "tflops": round(achieved / 1e12, 3),
         "mfu": round(achieved / peak, 4),
+        "xla_flops_vs_model_flops": xla_ratio,
         "n_params": n_params,
         "tokens_per_step": n_tokens,
         "vocab_chunk": vocab_chunk,
