@@ -206,69 +206,76 @@ def test_shard_global_norm_equals_full_norm():
         np.testing.assert_allclose(got, want, rtol=1e-12)
 
 
-def test_zero_state_checkpoint_resume(tmp_path):
-    """Crash/resume with SHARDED optimizer state: each rank saves its
-    own shard, restores it, and the resumed trajectory is identical to
-    the uninterrupted run on every rank."""
-    from mpi4torch_tpu.utils import save_checkpoint, restore_checkpoint
+def _checkpoint_resume_harness(tmp_path, init_fn, step_fn, final_fn):
+    """Shared crash/resume oracle for the ZeRO stages: run STEPS
+    uninterrupted, run STEPS/2 + save per rank + restore + STEPS/2, and
+    require identical final replicated parameters on every rank.
 
-    x, y, params0 = _data()
-    opt = optax.adam(1e-1)
+    ``init_fn() -> carry``; ``step_fn(carry, xl, yl) -> carry``;
+    ``final_fn(carry) -> replicated params tree`` — all called inside a
+    rank-thread.  Per-rank carries are DIFFERENT trees of the same
+    shape: each rank persists its own directory.  IO runs serialized on
+    the main thread — orbax checkpointers are not safe to call from the
+    rank-threads concurrently (under the multi-process runtime each
+    process has its own interpreter, so this is a thread-harness
+    artifact, not a deployment constraint).  The just-saved carries
+    serve as their own restore templates (restore only consumes
+    shape/dtype structure)."""
+    x, y, _ = _data()
     shard = N // NR
     half = STEPS // 2
 
-    def run_steps(params, state, xl, yl, n):
+    from mpi4torch_tpu.utils import save_checkpoint, restore_checkpoint
+
+    def local_xy():
+        xl = x[comm.rank * shard:(comm.rank + 1) * shard]
+        yl = y[comm.rank * shard:(comm.rank + 1) * shard]
+        return xl, yl
+
+    def run_steps(carry, n):
+        xl, yl = local_xy()
         for _ in range(n):
-            g = jax.grad(lambda p: _local_loss(p, xl, yl))(params)
-            params, state = zero_step(comm, opt, params, g, state)
-        return params, state
+            carry = step_fn(carry, xl, yl)
+        return carry
 
-    def uninterrupted():
-        xl = x[comm.rank * shard:(comm.rank + 1) * shard]
-        yl = y[comm.rank * shard:(comm.rank + 1) * shard]
-        params, state = run_steps(params0, zero_init(comm, opt, params0),
-                                  xl, yl, STEPS)
-        return params
+    ref = mpi.run_ranks(lambda: final_fn(run_steps(init_fn(), STEPS)), NR)
 
-    ref = mpi.run_ranks(uninterrupted, NR)
-
-    def first_half():
-        xl = x[comm.rank * shard:(comm.rank + 1) * shard]
-        yl = y[comm.rank * shard:(comm.rank + 1) * shard]
-        return run_steps(params0, zero_init(comm, opt, params0),
-                         xl, yl, half)
-
-    # Per-rank shard states are DIFFERENT trees of the same shape: each
-    # rank persists its own directory.  IO runs serialized on the main
-    # thread — orbax checkpointers are not safe to call from the
-    # rank-threads concurrently (under the multi-process runtime each
-    # process has its own interpreter, so this is a thread-harness
-    # artifact, not a deployment constraint).
-    halves = mpi.run_ranks(first_half, NR)
-    for r, (params, state) in enumerate(halves):
-        save_checkpoint(str(tmp_path / f"rank{r}"),
-                        {"params": params, "opt": state})
-
-    inits = mpi.run_ranks(lambda: zero_init(comm, opt, params0), NR)
+    halves = mpi.run_ranks(lambda: run_steps(init_fn(), half), NR)
+    for r, carry in enumerate(halves):
+        save_checkpoint(str(tmp_path / f"rank{r}"), carry)
     restored = [
-        restore_checkpoint(str(tmp_path / f"rank{r}"),
-                           {"params": params0, "opt": inits[r]})
+        restore_checkpoint(str(tmp_path / f"rank{r}"), halves[r])
         for r in range(NR)
     ]
 
-    def resumed():
-        xl = x[comm.rank * shard:(comm.rank + 1) * shard]
-        yl = y[comm.rank * shard:(comm.rank + 1) * shard]
-        got = restored[comm.rank]
-        return run_steps(got["params"], got["opt"], xl, yl,
-                         STEPS - half)[0]
-
-    outs = mpi.run_ranks(resumed, NR)
+    outs = mpi.run_ranks(
+        lambda: final_fn(run_steps(restored[comm.rank], STEPS - half)),
+        NR)
     for got, want in zip(outs, ref):
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-12),
             got, want)
+
+
+def test_zero_state_checkpoint_resume(tmp_path):
+    """Crash/resume with SHARDED optimizer state: each rank saves its
+    own shard, restores it, and the resumed trajectory is identical to
+    the uninterrupted run on every rank."""
+    _, _, params0 = _data()
+    opt = optax.adam(1e-1)
+
+    def init_fn():
+        return {"params": params0, "opt": zero_init(comm, opt, params0)}
+
+    def step_fn(carry, xl, yl):
+        g = jax.grad(lambda p: _local_loss(p, xl, yl))(carry["params"])
+        params, state = zero_step(comm, opt, carry["params"], g,
+                                  carry["opt"])
+        return {"params": params, "opt": state}
+
+    _checkpoint_resume_harness(tmp_path, init_fn, step_fn,
+                               lambda c: c["params"])
 
 
 class TestZero3:
@@ -377,3 +384,29 @@ class TestZero3:
         assert txt.count("stablehlo.all_gather") >= 1
         assert txt.count("stablehlo.reduce_scatter") >= 1
         assert txt.count("stablehlo.all_reduce") == 0, txt
+
+    def test_zero3_state_checkpoint_resume(self, tmp_path):
+        """Crash/resume with SHARDED PARAMETERS: each rank persists its
+        1/size parameter shard + optimizer shard (the whole point of
+        stage 3 — no rank ever needs to materialize the full tree to
+        checkpoint), and the resumed trajectory is identical to the
+        uninterrupted run."""
+        from mpi4torch_tpu.parallel import (zero3_init, zero3_params,
+                                            zero3_step)
+
+        _, _, params0 = _data()
+        opt = optax.adam(1e-1)
+
+        def init_fn():
+            ps, st = zero3_init(comm, opt, params0)
+            return {"p_shards": ps, "opt": st}
+
+        def step_fn(carry, xl, yl):
+            _, ps, st = zero3_step(
+                comm, opt, carry["p_shards"], params0,
+                lambda p: _local_loss(p, xl, yl), carry["opt"])
+            return {"p_shards": ps, "opt": st}
+
+        _checkpoint_resume_harness(
+            tmp_path, init_fn, step_fn,
+            lambda c: zero3_params(comm, c["p_shards"], params0))
