@@ -169,6 +169,34 @@ class TestPackedAlltoall:
             want[:NEW[r], 0] = np.arange(new_offs[r], new_offs[r + 1])
             np.testing.assert_array_equal(out[r], want)
 
+    def test_same_axis_redistribution_grad(self):
+        # Cotangents must route back through the repartition to exactly
+        # the valid source slots (each global element appears in exactly
+        # one new span; padding contributes nothing).
+        NEW = tuple(NR - r for r in range(NR))
+
+        def prog(x):
+            mine = jnp.take(x, jnp.asarray(comm.rank + 0), axis=0)
+            out = comm.Alltoall(mine, 0, 0, NEW, current_numelem=COUNTS)
+            w = 1.0 + jnp.asarray(comm.rank + 0, out.dtype)
+            return jnp.sum(out * w)
+
+        x = jnp.ones((NR, CAP, 2))
+        g = np.asarray(jax.grad(lambda x: run(prog)(x).sum())(x))
+        # Rank r's valid slot feeding new-owner j gets weight 1+j; its
+        # padding slots get exactly zero.
+        new_offs = np.concatenate([[0], np.cumsum(NEW)])
+        flat_owner = np.zeros(TOTAL, np.int64)
+        for j in range(NR):
+            flat_owner[new_offs[j]:new_offs[j + 1]] = j
+        for r in range(NR):
+            for i in range(CAP):
+                if i < COUNTS[r]:
+                    owner = flat_owner[OFFS[r] + i]
+                    assert (g[r, i] == 1.0 + owner).all(), (r, i)
+                else:
+                    assert (g[r, i] == 0).all(), (r, i)
+
     def test_same_axis_requires_current_numelem(self):
         with pytest.raises(ValueError, match="current_numelem"):
             run(lambda x: comm.Alltoall(x, 0, 0, COUNTS))(
