@@ -488,6 +488,27 @@ def _check_tag(tag: int) -> None:
         )
 
 
+def _resolve_peer(ctx: RankContext, peer, what: str) -> int:
+    """Concrete peer rank.  A per-rank table (the SPMD backend's portable
+    permutation form, ops/spmd.py PermRank) resolves to THIS rank's
+    entry, so the same program text runs on both backends; plain ints
+    pass through (eager additionally allows arbitrary non-bijective
+    destinations, exactly like MPI)."""
+    if isinstance(peer, (list, tuple)):
+        size = ctx.world.size
+        if len(peer) != size:
+            raise CommError(
+                f"{what} table has {len(peer)} entries for world size "
+                f"{size}")
+        peer = peer[ctx.rank]
+    try:
+        return int(peer)
+    except (TypeError, ValueError):
+        raise CommError(
+            f"{what} must be an integer rank or a per-rank table; got "
+            f"{peer!r}") from None
+
+
 def isend(ctx: RankContext, x, dest: int, tag: int) -> List:
     """Nonblocking send (reference: csrc/extension.cpp:1071-1113).
 
@@ -502,6 +523,7 @@ def isend(ctx: RankContext, x, dest: int, tag: int) -> List:
     world, rank = ctx.world, ctx.rank
     world.check_not_consumed(x)
     _check_tag(tag)
+    dest = _resolve_peer(ctx, dest, "destination")
     req = world.new_request(REQ_ISEND, rank, dest, tag, tuple(x.shape),
                             jnp.asarray(x).dtype)
     desc = _make_descriptor(req)
@@ -539,6 +561,7 @@ def irecv(ctx: RankContext, x, source: int, tag: int) -> List:
     world, rank = ctx.world, ctx.rank
     world.check_not_consumed(x)
     _check_tag(tag)
+    source = _resolve_peer(ctx, source, "source")
     req = world.new_request(REQ_IRECV, rank, source, tag, tuple(x.shape),
                             jnp.asarray(x).dtype)
     desc = _make_descriptor(req)

@@ -578,6 +578,38 @@ class TestGeneralPermutationsP2P:
             run(prog)(jnp.ones(1))
 
 
+class TestEagerPeerTables:
+    def test_table_program_runs_on_both_backends(self):
+        # The SPMD backends' portable permutation-table form must run
+        # unchanged on the eager backend (each rank takes its entry).
+        table = [NR - 1 - r for r in range(NR)]
+
+        def prog(a0):
+            a = a0 * (1.0 + comm.rank)
+            h = comm.Isend(a, table, 2)
+            b = comm.Recv(mpi.JoinDummies(jnp.empty_like(a), [h.dummy]),
+                          table, 2)
+            comm.Wait(mpi.JoinDummiesHandle(h, [b]))
+            return b
+
+        spmd = np.asarray(run(prog)(jnp.ones(2)))
+        eager = {}
+
+        def body():
+            eager[comm.rank] = np.asarray(prog(jnp.ones(2)))
+
+        mpi.run_ranks(body, NR)
+        for r in range(NR):
+            np.testing.assert_array_equal(eager[r], spmd[r])
+
+    def test_wrong_length_table_rejected_eager(self):
+        def body():
+            with pytest.raises(mpi.CommError, match="entries"):
+                comm.Isend(jnp.ones(1), [0] * (4 + 1), 0)
+
+        mpi.run_ranks(body, 4)
+
+
 class TestEagerSelfSend:
     def test_self_send_eager(self):
         # MPI semantics: Isend(dest=rank) + Recv(source=rank) completes
