@@ -99,6 +99,23 @@ def _compute_dtype(q):
     return jnp.promote_types(q.dtype, jnp.float32)
 
 
+def dot_precision(dtype):
+    """Contract precision for the attention matmuls, chosen by operand dtype.
+
+    Under ``precision=DEFAULT`` the TPU MXU contracts even f32 operands in
+    single bf16 passes — measured ~3e0 max relative error against the f32
+    product on a v5e.  That is the right trade for bf16 inputs (one fast
+    pass; Mosaic rejects an fp32 contract precision on bf16 vectors
+    outright), but it silently strips an f32 attention call to ~3
+    significant digits and makes kernel-vs-oracle comparison ill-posed:
+    each side reassociates *different* bf16 partials.  So f32-or-wider
+    operands pin ``HIGHEST`` (the MXU's multi-pass f32-exact algorithm)
+    and narrower ones keep the single-pass default.  CPU ignores the flag
+    either way, so the x64 oracle suite is unaffected."""
+    return (jax.lax.Precision.HIGHEST
+            if jnp.dtype(dtype).itemsize >= 4 else None)
+
+
 def _gqa_groups(q, k) -> int:
     """Query heads per KV head (grouped-query attention).  1 = plain MHA;
     q head ``h`` attends through KV head ``h // g`` (the repeat-interleave
@@ -140,7 +157,12 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool, window: int = 0):
     g = _gqa_groups(q, k)
     k, v = _group_repeat_kv(k, g), _group_repeat_kv(v, g)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, ct))
-    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(ct), k.astype(ct)) * scale
+    # Precision keyed on the INPUT dtype: bf16 inputs keep the single-pass
+    # contract even though operands are staged in f32 here, matching the
+    # kernel path's cost and accuracy (see dot_precision).
+    prec = dot_precision(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(ct), k.astype(ct),
+                   precision=prec) * scale
     if causal:
         q_pos = q_off + jnp.arange(sq, dtype=jnp.int32)
         kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
@@ -155,7 +177,7 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool, window: int = 0):
     if causal:
         p = jnp.where(mask[None, :, None, :], p, 0.0)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(ct))
+    acc = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(ct), precision=prec)
     safe_l = jnp.where(l > 0, l, 1.0)
     out = jnp.where(l[..., None] > 0, acc / safe_l[..., None], 0.0)
     lse = jnp.where(l > 0, m + jnp.log(safe_l), NEG_BIG)
@@ -220,6 +242,8 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # run at the MXU's bf16 rate; an up-front astype(f32) would force
     # f32-rate multiplies) — accumulation is f32 via
     # preferred_element_type, and the scale is applied to the f32 scores.
+    # f32 operands pin the f32-exact contract (see dot_precision).
+    prec = dot_precision(q_ref.dtype)
     qb = q_ref[0]                                           # (QT, D)
     qi = pl.program_id(1)
     q_pos = (qoff_ref[0, 0] + qi * qt
@@ -231,7 +255,7 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         vb = v_ref[0, pl.ds(j * kv_tile, kv_tile), :]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=f32) * scale              # (QT, KT)
+            preferred_element_type=f32, precision=prec) * scale  # (QT, KT)
         if causal:
             kv_pos = (kvoff_ref[0, 0] + j * kv_tile
                       + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
@@ -247,7 +271,7 @@ def _fwd_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * corr + jax.lax.dot_general(
             p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=f32)
+            preferred_element_type=f32, precision=prec)
         return m_new, l, acc
 
     m0 = jnp.full((qt, 1), NEG_BIG, f32)
@@ -374,7 +398,7 @@ def _stat_tile(x, width: int):
 
 
 def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
-              causal: bool, scale, window: int = 0):
+              causal: bool, scale, window, prec):
     """Recompute p and ds for one (q-tile, kv-tile) pair, in-kernel.
 
     ``lse`` and ``dd = delta - dlse`` arrive as (QT, KT) lane-broadcast
@@ -387,7 +411,8 @@ def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
     f32 = jnp.float32
     # Native-dtype MXU operands, f32 accumulation (see _fwd_kernel).
     s = jax.lax.dot_general(q_t, k_t, (((1,), (1,)), ((), ())),
-                            preferred_element_type=f32) * scale   # (QT, KT)
+                            preferred_element_type=f32,
+                            precision=prec) * scale               # (QT, KT)
     p = jnp.exp(s - lse_t)
     if causal:
         mask = q_pos >= kv_pos                                    # (QT, KT)
@@ -395,7 +420,7 @@ def _bwd_p_ds(q_t, k_t, v_t, do_t, lse_t, dd_t, q_pos, kv_pos,
             mask &= (q_pos - kv_pos) < window
         p = jnp.where(mask, p, 0.0)
     dp_ = jax.lax.dot_general(do_t, v_t, (((1,), (1,)), ((), ())),
-                              preferred_element_type=f32)
+                              preferred_element_type=f32, precision=prec)
     ds = p * (dp_ - dd_t)
     return p, ds
 
@@ -410,6 +435,7 @@ def _bwd_dq_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
     qt, d = q_ref.shape[1], q_ref.shape[2]
     sk = k_ref.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(true_d, f32))
+    prec = dot_precision(q_ref.dtype)
 
     qb = q_ref[0]
     dob = do_ref[0]
@@ -425,10 +451,10 @@ def _bwd_dq_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
         kv_pos = (kvoff_ref[0, 0] + j * kv_tile
                   + jax.lax.broadcasted_iota(i32, (1, kv_tile), 1))
         _, ds = _bwd_p_ds(qb, kb, vb, dob, lse_t, dd_t,
-                          q_pos, kv_pos, causal, scale, window)
+                          q_pos, kv_pos, causal, scale, window, prec)
         return dq + jax.lax.dot_general(
             ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=f32) * scale
+            preferred_element_type=f32, precision=prec) * scale
 
     n_kv = sk // kv_tile
     n_live = (_causal_n_live(qoff_ref[0, 0], kvoff_ref[0, 0], qi, qt,
@@ -450,6 +476,7 @@ def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
     kt, d = k_ref.shape[1], k_ref.shape[2]
     sq = q_ref.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(true_d, f32))
+    prec = dot_precision(q_ref.dtype)
 
     kb = k_ref[0]
     vb = v_ref[0]
@@ -467,13 +494,13 @@ def _bwd_dkv_kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref,
         q_pos = (qoff_ref[0, 0] + i * q_tile
                  + jax.lax.broadcasted_iota(i32, (q_tile, 1), 0))
         p, ds = _bwd_p_ds(q_t, kb, vb, do_t, lse_t, dd_t,
-                          q_pos, kv_pos, causal, scale, window)
+                          q_pos, kv_pos, causal, scale, window, prec)
         dv = dv + jax.lax.dot_general(
             p.astype(do_t.dtype), do_t, (((0,), (0,)), ((), ())),
-            preferred_element_type=f32)                    # (KT, D)
+            preferred_element_type=f32, precision=prec)    # (KT, D)
         dk = dk + jax.lax.dot_general(
             ds.astype(q_t.dtype), q_t, (((0,), (0,)), ((), ())),
-            preferred_element_type=f32) * scale
+            preferred_element_type=f32, precision=prec) * scale
         return dk, dv
 
     dk0 = jnp.zeros((kt, d), f32)
@@ -748,10 +775,10 @@ _BWD_TILE_ABOVE = 512
 
 
 def _bwd_tile_math(qf, k_tile, v_tile, do, lse, delta, dlse, q_pos,
-                   kv_pos_tile, causal, scale, window=0):
+                   kv_pos_tile, causal, scale, window, prec):
     """Gradient contributions of one KV tile (shared by the one-shot and
     tiled paths; flash backward: ds = p * (dp - delta + dlse))."""
-    s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_tile) * scale
+    s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_tile, precision=prec) * scale
     if causal:
         m2 = q_pos[:, None] >= kv_pos_tile[None, :]
         if window:
@@ -761,11 +788,11 @@ def _bwd_tile_math(qf, k_tile, v_tile, do, lse, delta, dlse, q_pos,
     p = jnp.exp(s - lse[..., None])          # = softmax over this block
     if causal:
         p = jnp.where(mask, p, 0.0)
-    dp = jnp.einsum("bqhd,bkhd->bqhk", do, v_tile)
-    dv = jnp.einsum("bqhk,bqhd->bkhd", p, do)
+    dp = jnp.einsum("bqhd,bkhd->bqhk", do, v_tile, precision=prec)
+    dv = jnp.einsum("bqhk,bqhd->bkhd", p, do, precision=prec)
     ds = p * (dp - delta[..., None] + dlse[..., None])
-    dq = jnp.einsum("bqhk,bkhd->bqhd", ds, k_tile) * scale
-    dk = jnp.einsum("bqhk,bqhd->bkhd", ds, qf) * scale
+    dq = jnp.einsum("bqhk,bkhd->bqhd", ds, k_tile, precision=prec) * scale
+    dk = jnp.einsum("bqhk,bqhd->bkhd", ds, qf, precision=prec) * scale
     return dq, dk, dv
 
 
@@ -822,9 +849,11 @@ def _block_bwd(causal, impl, window, res, cot):
     kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
 
     kt = _KV_TILE
+    prec = dot_precision(q.dtype)
     if sk <= _BWD_TILE_ABOVE or sk % kt != 0:
         dq, dk, dv = _bwd_tile_math(qf, kf, vf, do, lse, delta, dlse,
-                                    q_pos, kv_pos, causal, scale, window)
+                                    q_pos, kv_pos, causal, scale, window,
+                                    prec)
     else:
         def body(j, carry):
             dq, dk, dv = carry
@@ -833,7 +862,7 @@ def _block_bwd(causal, impl, window, res, cot):
             kv_pos_t = jax.lax.dynamic_slice_in_dim(kv_pos, j * kt, kt, 0)
             dq_t, dk_t, dv_t = _bwd_tile_math(
                 qf, k_t, v_t, do, lse, delta, dlse, q_pos, kv_pos_t,
-                causal, scale, window)
+                causal, scale, window, prec)
             dq = dq + dq_t
             dk = jax.lax.dynamic_update_slice_in_dim(dk, dk_t, j * kt, 1)
             dv = jax.lax.dynamic_update_slice_in_dim(dv, dv_t, j * kt, 1)

@@ -31,20 +31,32 @@ def _causal_bias(q_pos, kv_pos, dtype):
     return jnp.where(mask, jnp.zeros([], dtype), jnp.asarray(_NEG_BIG, dtype))
 
 
-def dense_attention(q, k, v, causal: bool = False, q_offset=0, kv_offset=0):
+def dense_attention(q, k, v, causal: bool = False, q_offset=0, kv_offset=0,
+                    precision=None):
     """Reference single-device scaled-dot-product attention.
 
     ``q_offset``/``kv_offset`` are the global positions of the first query/
-    key, so shards of a longer sequence mask correctly."""
+    key, so shards of a longer sequence mask correctly.  ``precision``
+    overrides the contract precision of both matmuls; the default (None)
+    keys it on the input dtype — f32 inputs pin the MXU's f32-exact
+    multi-pass contract (torch parity: the reference backend computes f32
+    as f32; TPU's single-pass default would silently contract at bf16),
+    bf16 inputs keep the fast single pass.  Production paths that prefer
+    speed over f32 exactness (e.g. tp_attention with f32 activations)
+    can pass ``jax.lax.Precision.DEFAULT`` — or simply run bf16, the
+    recommended TPU activation dtype."""
+    from ..ops.flash import dot_precision
+
     dtype = q.dtype
+    prec = dot_precision(dtype) if precision is None else precision
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype))
-    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k, precision=prec) * scale
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         kv_pos = kv_offset + jnp.arange(k.shape[1])
         scores = scores + _causal_bias(q_pos, kv_pos, dtype)[:, None, :]
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bqhk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bqhk,bkhd->bqhd", probs, v, precision=prec)
 
 
 def ring_attention(comm, q, k, v, causal: bool = False, tag: int = 0,

@@ -779,6 +779,10 @@ class TestRingAttentionPallas:
         # 4-rank ring over eligible f32 shapes, kernel interpreted: the
         # full CP path through the Pallas block primitive.
         NR = 4
+        if len(jax.devices()) < NR:
+            # Real-device mode exposes the single physical chip; the mesh
+            # transport needs NR devices (CPU harness forces 8 virtual).
+            pytest.skip(f"needs {NR} devices, have {len(jax.devices())}")
         S_TOT = 512
         q, k, v = qkv((1, S_TOT, 2, 128), dtype=jnp.float32)
         ref = dense_attention(q, k, v, causal=True)
