@@ -5,8 +5,11 @@
 #   1. make tpu-test          (the compiled-Pallas kernel tests)
 #   2. python bench.py        (BASELINE.md headline metrics)
 #   3. python bench_tradeoffs.py  (perf-constant calibration sweeps)
-# — teeing raw logs + timestamps into TPU_EVIDENCE/ so a later tunnel
-# outage cannot erase the proof.  Exits 0 once evidence is on disk.
+# — teeing raw logs + timestamps into TPU_EVIDENCE/, regenerating
+# TPU_EVIDENCE.md, and GIT-COMMITTING the result (round-5 lesson: the
+# tunnel's 03:48Z window closed ~15 minutes after the pipeline finished;
+# evidence that is not committed the moment it exists can be lost to a
+# session restart).  Exits 0 once evidence is on disk and committed.
 #
 # Usage: tools/tpu_evidence.sh [max_hours]   (default 11)
 set -u
@@ -33,7 +36,8 @@ n=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     n=$((n + 1))
     if probe; then
-        echo "probe $n succeeded at $(date -u +%FT%TZ)" | tee "$EV/00_probe.log"
+        STAMP=$(date -u +%FT%TZ)
+        echo "probe $n succeeded at $STAMP" | tee "$EV/00_probe.log"
         cat "$EV/probe_last.log" >>"$EV/00_probe.log"
 
         echo "=== make tpu-test @ $(date -u +%FT%TZ) ===" >"$EV/01_tpu_test.log"
@@ -53,7 +57,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         # Summarize into the committed artifact (VERDICT r4 item 1:
         # raw logs + timestamps as TPU_EVIDENCE.md, un-losable).
         {
-            echo "# TPU evidence — round 5"
+            echo "# TPU evidence — round 5 (collected $STAMP)"
             echo
             echo "Collected unattended by tools/tpu_evidence.sh the moment"
             echo "the tunnel came up.  Raw logs in TPU_EVIDENCE/."
@@ -78,6 +82,12 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
             tail -n 60 "$EV/03_tradeoffs.log"
             echo '```'
         } >"TPU_EVIDENCE.md"
+
+        git add TPU_EVIDENCE TPU_EVIDENCE.md
+        git commit -m "On-chip evidence collected $STAMP (unattended pipeline)
+
+No-Verification-Needed: telemetry/evidence logs only, no product code" \
+            >>"$EV/00_probe.log" 2>&1
         exit 0
     fi
     echo "probe $n failed at $(date -u +%FT%TZ)" >>"$EV/probe_history.log"
