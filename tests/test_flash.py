@@ -798,3 +798,39 @@ class TestRingAttentionPallas:
         got = np.concatenate(list(out), axis=1)
         np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4,
                                    atol=2e-5)
+
+
+class TestDotPrecision:
+    """The on-chip precision contract (round-5 postmortem): TPU contracts
+    f32 dot_generals in single bf16 passes at default precision, so every
+    attention matmul keys its contract precision on the operand dtype —
+    f32-or-wider pins HIGHEST, narrower stays on the fast single pass
+    (Mosaic rejects fp32 contract precision on bf16 operands).  Asserted
+    at the jaxpr level so the policy is CPU-checkable."""
+
+    def test_dot_precision_by_dtype(self):
+        assert flash.dot_precision(jnp.float32) == jax.lax.Precision.HIGHEST
+        assert flash.dot_precision(jnp.float64) == jax.lax.Precision.HIGHEST
+        assert flash.dot_precision(jnp.bfloat16) is None
+        assert flash.dot_precision(jnp.float16) is None
+
+    @pytest.mark.parametrize("fn", [
+        lambda q: dense_attention(q, q, q, causal=True),
+        lambda q: flash.flash_block_attention(q, q, q, causal=True,
+                                              impl="jnp")[0],
+        lambda q: jax.grad(lambda t: jnp.sum(flash.flash_block_attention(
+            t, t, t, causal=True, impl="jnp")[0] ** 2))(q),
+    ], ids=["dense", "flash_jnp_fwd", "flash_jnp_bwd"])
+    def test_f32_pins_highest_bf16_does_not(self, fn):
+        q32 = jnp.ones((1, 8, 1, 8), jnp.float32)
+        assert "HIGHEST" in str(jax.make_jaxpr(fn)(q32))
+        q16 = q32.astype(jnp.bfloat16)
+        assert "HIGHEST" not in str(jax.make_jaxpr(fn)(q16))
+
+    def test_dense_attention_precision_override(self):
+        # Callers preferring the single-pass contract for f32 (speed over
+        # exactness) can opt out.
+        q = jnp.ones((1, 8, 1, 8), jnp.float32)
+        jx = str(jax.make_jaxpr(lambda t: dense_attention(
+            t, t, t, precision=jax.lax.Precision.DEFAULT))(q))
+        assert "HIGHEST" not in jx
