@@ -236,6 +236,78 @@ def bench_flash_tiling(n):
     return results
 
 
+def bench_native_reduce_crossover(n):
+    """``_NATIVE_REDUCE_MIN_SIZE``: the fused native C ordered fold vs the
+    pure-jnp fold for CPU-RESIDENT operands (constants.py:102-104 — the
+    threshold only gates data already on the host, so this sweep is valid
+    on any platform; operands are pinned to the CPU backend).  Both paths
+    are documented bit-equal; each point cross-checks that before its
+    timings count.  Host numpy is synchronous, so plain perf_counter
+    brackets are a sound barrier here (no tunnel in the path)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4torch_tpu import MPI_SUM, _native
+    from mpi4torch_tpu import constants as C
+
+    if not _native.available():
+        return {"skipped": "native library unavailable"}
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def forced_path(thresh):
+        saved = C._NATIVE_REDUCE_MIN_SIZE
+        C._NATIVE_REDUCE_MIN_SIZE = thresh
+        try:
+            yield
+        finally:
+            C._NATIVE_REDUCE_MIN_SIZE = saved
+
+    modes = (("native", 0), ("jnp_fold", 1 << 62))
+    cpu = jax.devices("cpu")[0]
+    rng = np.random.default_rng(0)
+    results = []
+    for log2_elems in range(8, 23, 2):          # 256 .. 4M elements
+        nelem = 1 << log2_elems
+        with jax.default_device(cpu):
+            vals = [jnp.asarray(rng.standard_normal(nelem), jnp.float32)
+                    for _ in range(8)]
+            point = {"elements": nelem, "bytes": nelem * 4}
+            outs = {}
+            for mode, thresh in modes:
+                with forced_path(thresh):
+                    outs[mode] = np.asarray(C.reduce_ordered(MPI_SUM, vals))
+            point["bit_equal"] = bool(
+                np.array_equal(outs["native"], outs["jnp_fold"]))
+            if not point["bit_equal"]:
+                # Timings of a wrong kernel are not data: a point that
+                # fails the bit-equality contract reports only the
+                # failure (never a speedup someone might act on).
+                results.append(point)
+                _note(f"native_reduce {nelem} elems: BIT-EQUALITY BROKEN")
+                continue
+            for mode, thresh in modes:
+                with forced_path(thresh):
+                    iters = 30 if nelem <= (1 << 18) else 10
+                    ts = []
+                    for _ in range(iters):
+                        t0 = time.perf_counter()
+                        np.asarray(C.reduce_ordered(MPI_SUM, vals))
+                        ts.append(time.perf_counter() - t0)
+                    ts.sort()
+                    point[f"{mode}_s"] = ts[len(ts) // 2]
+            point["native_speedup"] = point["jnp_fold_s"] / point["native_s"]
+        results.append(point)
+        _note(f"native_reduce {nelem} elems: native {point['native_s']:.2e}s"
+              f" vs jnp {point['jnp_fold_s']:.2e}s"
+              f" (bit_equal={point['bit_equal']})")
+    return results
+
+
 def bench_reduce_scatter(n):
     """Reduce_scatter vs Allreduce-then-slice (the ZeRO gradient path;
     parallel/zero.py).  On a multi-chip mesh the native psum_scatter is
@@ -293,6 +365,7 @@ def main():
                      ("deterministic", bench_deterministic_overhead),
                      ("ordered_fold_paths", bench_ordered_fold_paths),
                      ("flash_tiling", bench_flash_tiling),
+                     ("native_reduce_crossover", bench_native_reduce_crossover),
                      ("reduce_scatter", bench_reduce_scatter)):
         try:
             result[name] = fn(n)

@@ -97,15 +97,77 @@ inline T combine_int(int32_t op, T a, T b) {
 // loop runs over elements with the rank fold innermost, keeping exactly the
 // same floating-point association as the sequential rank-order fold while
 // touching each output element once.
+//
+// OP is a compile-time constant here (the runtime `op` switch is hoisted
+// into ordered_reduce below): Combine() folds to the single operation, so
+// the element loop auto-vectorizes, and OpenMP splits it across cores for
+// large n.  Each output element's rank-fold order is unchanged by either,
+// so the result stays bit-equal to the sequential fold regardless of
+// vector width or thread count.  (Measured on the round-5 host: the
+// runtime-switch single-thread form lost to XLA's 7-pass jnp fold ~2x at
+// every size; this form is what the one-memory-pass argument promised.)
+// Cache-blocked: an L1-resident accumulator chunk takes one vectorized
+// streaming pass PER RANK BUFFER.  The per-pass pointers are __restrict
+// locals — with the naive `out[i] = fold(bufs[..][i])` form the compiler
+// cannot prove bufs[r] does not alias out and never vectorizes (measured
+// on the round-5 host: ~7 GB/s vs the ~19 GB/s XLA's fold streams).
+// Total traffic stays one read of every input + one write of the output;
+// the fold order per element is untouched by chunking, vector width, or
+// OpenMP, so bit-equality to the sequential fold is preserved.
+// Concurrency note: the thread-SPMD executor can invoke this kernel from
+// several rank threads at once on paths where each rank folds DISTINCT
+// data (reduce_scatter slices; the redundant same-data folds were
+// removed Python-side — Allreduce folds once, Reduce_ folds on root
+// only).  Each caller opens its own OpenMP team; on many-core hosts
+// running wide thread worlds, cap the team size with OMP_NUM_THREADS
+// (~cores / world size) to avoid oversubscription.  The crossover
+// threshold (constants._NATIVE_REDUCE_MIN_SIZE) was calibrated
+// single-caller, which after the Python-side dedup is the common case.
+template <typename T, T (*Combine)(int32_t, T, T), int32_t OP>
+void ordered_reduce_fixed(const T* const* bufs, int32_t nbufs, int64_t n,
+                          T* out) {
+  constexpr int64_t CHUNK = 4096;  // 16-32 KiB of T: comfortably L1/L2
+#pragma omp parallel for schedule(static) if (n >= (int64_t)1 << 16)
+  for (int64_t c0 = 0; c0 < n; c0 += CHUNK) {
+    const int64_t m = (n - c0 < CHUNK) ? (n - c0) : CHUNK;
+    T acc[CHUNK];
+    const T* __restrict b0 = bufs[0] + c0;
+    for (int64_t i = 0; i < m; ++i) acc[i] = b0[i];
+    for (int32_t r = 1; r < nbufs; ++r) {
+      const T* __restrict b = bufs[r] + c0;
+      for (int64_t i = 0; i < m; ++i) acc[i] = Combine(OP, acc[i], b[i]);
+    }
+    T* __restrict o = out + c0;
+    for (int64_t i = 0; i < m; ++i) o[i] = acc[i];
+  }
+}
+
 template <typename T, T (*Combine)(int32_t, T, T)>
 void ordered_reduce(const T* const* bufs, int32_t nbufs, int64_t n,
                     int32_t op, T* out) {
-  for (int64_t i = 0; i < n; ++i) {
-    T acc = bufs[0][i];
-    for (int32_t r = 1; r < nbufs; ++r) {
-      acc = Combine(op, acc, bufs[r][i]);
-    }
-    out[i] = acc;
+  switch (op) {
+    case OP_SUM:
+      return ordered_reduce_fixed<T, Combine, OP_SUM>(bufs, nbufs, n, out);
+    case OP_PROD:
+      return ordered_reduce_fixed<T, Combine, OP_PROD>(bufs, nbufs, n, out);
+    case OP_MAX:
+      return ordered_reduce_fixed<T, Combine, OP_MAX>(bufs, nbufs, n, out);
+    case OP_MIN:
+      return ordered_reduce_fixed<T, Combine, OP_MIN>(bufs, nbufs, n, out);
+    case OP_LAND:
+      return ordered_reduce_fixed<T, Combine, OP_LAND>(bufs, nbufs, n, out);
+    case OP_BAND:
+      return ordered_reduce_fixed<T, Combine, OP_BAND>(bufs, nbufs, n, out);
+    case OP_LOR:
+      return ordered_reduce_fixed<T, Combine, OP_LOR>(bufs, nbufs, n, out);
+    case OP_BOR:
+      return ordered_reduce_fixed<T, Combine, OP_BOR>(bufs, nbufs, n, out);
+    case OP_LXOR:
+      return ordered_reduce_fixed<T, Combine, OP_LXOR>(bufs, nbufs, n, out);
+    case OP_BXOR:
+      return ordered_reduce_fixed<T, Combine, OP_BXOR>(bufs, nbufs, n, out);
+    default:  // validated on the Python side; Combine's default is identity
+      return ordered_reduce_fixed<T, Combine, 0>(bufs, nbufs, n, out);
   }
 }
 

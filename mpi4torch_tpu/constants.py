@@ -49,6 +49,16 @@ def op_name(op: int) -> str:
     return _OP_NAMES.get(op, f"<unknown op {op}>")
 
 
+def fold_supported(op: int) -> bool:
+    """True iff combine2/reduce_ordered can evaluate ``op`` (everything
+    but the pair-semantics MINLOC/MAXLOC and unknown codes).  Lets
+    callers that delegate a fold to one rank (eager Allreduce fold-once)
+    keep unsupported ops on the every-rank path, so the informative
+    rejection raises identically on every rank instead of as a rank-0
+    death plus broken-barrier aborts elsewhere."""
+    return op in _OP_NAMES and op not in (MPI_MINLOC, MPI_MAXLOC)
+
+
 def combine2(op: int, a, b):
     """Elementwise combination of two operands for reduction op ``op``.
 
@@ -100,8 +110,12 @@ def combine2(op: int, a, b):
 
 
 # Below this element count the N-1 jnp folds beat the host round-trip of
-# the native kernel.
-_NATIVE_REDUCE_MIN_SIZE = 32768
+# the native kernel.  Measured (bench_tradeoffs.py native_reduce_crossover,
+# 8 f32 buffers, round-5 single-core host): native/jnp seconds were
+# 3.5e-4/2.4e-4 at 64Ki elements, 7.5e-4/1.04e-3 at 256Ki, 2.3e-3/3.7e-3
+# at 1Mi — the blocked one-pass C fold wins ~1.4-1.6x above the ~128Ki
+# crossover, loses to dispatch overhead below it.
+_NATIVE_REDUCE_MIN_SIZE = 131072
 
 
 def _on_cpu(v) -> bool:

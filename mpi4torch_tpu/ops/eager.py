@@ -80,6 +80,12 @@ def _shape_sig(x) -> Tuple:
 # Blocking collectives
 # =========================================================================
 
+# Element count above which Allreduce folds once (rank 0) and shares the
+# result instead of every rank thread folding the same list redundantly.
+# The redundant folds serialize on the host's cores; the share costs one
+# extra exchange (two barrier waits, ~tens of µs at thread scale).
+_FOLD_ONCE_MIN = 65536
+
 def allreduce(ctx: RankContext, x, op: int):
     """Differentiable Allreduce (reference: csrc/extension.cpp:274-308).
 
@@ -87,11 +93,22 @@ def allreduce(ctx: RankContext, x, op: int):
     matching the reference's MPIUnimplementedNode (csrc/extension.cpp:194-202,
     279-283)."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
 
     def impl(v):
         _check_concrete(v)
-        vals = world.exchange(rank, ("Allreduce", op, _shape_sig(v)), v)
+        sig = _shape_sig(v)
+        vals = world.exchange(rank, ("Allreduce", op, sig), v)
+        if jnp.asarray(v).size >= _FOLD_ONCE_MIN and C.fold_supported(op):
+            # Every rank would compute the IDENTICAL ascending-rank fold;
+            # above the threshold, rank 0 folds once and a second
+            # rendezvous shares the (immutable) result — W-1 redundant
+            # folds saved, and the fold runs single-caller, matching the
+            # pattern _NATIVE_REDUCE_MIN_SIZE is calibrated for
+            # (constants.py).  Below it, two extra barrier waits cost
+            # more than the duplicate tiny folds.
+            red = C.reduce_ordered(op, vals) if rank == 0 else None
+            return world.exchange(rank, ("Allreduce.fold", op, sig), red)[0]
         return C.reduce_ordered(op, vals)
 
     @jax.custom_vjp
@@ -126,7 +143,7 @@ def reduce_scatter(ctx: RankContext, x, op: int, scatteraxis: int):
     allgather of the shard cotangents — each rank's input gradient is the
     full concatenation."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     ax = _norm_axis(scatteraxis, jnp.ndim(x))
     size = world.size
     if x.shape[ax] % size != 0:
@@ -178,7 +195,7 @@ def bcast_(ctx: RankContext, x, root: int):
     tensor on every rank.  Adjoint: Reduce_(grad, SUM, root)
     (csrc/extension.cpp:310-331)."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     _check_root(world, root)
 
     def impl(v):
@@ -189,8 +206,12 @@ def bcast_(ctx: RankContext, x, root: int):
     def reduce_impl(g):
         _check_concrete(g)
         vals = world.exchange(rank, ("Bcast_.bwd", root, _shape_sig(g)), g)
-        red = C.reduce_ordered(C.MPI_SUM, vals)
-        return red if rank == root else jnp.zeros_like(red)
+        # Only root keeps the reduction; non-root ranks skip the fold
+        # entirely instead of computing it and zeroing it (their folds
+        # would serialize redundantly on the host's cores).
+        if rank == root:
+            return C.reduce_ordered(C.MPI_SUM, vals)
+        return jnp.zeros_like(g)
 
     @jax.custom_vjp
     def f(v):
@@ -210,14 +231,20 @@ def reduce_(ctx: RankContext, x, op: int, root: int):
     reuse guard (csrc/extension.cpp:395-403, 451-462).  Adjoint:
     Bcast_(grad, root); only MPI_SUM is differentiable."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     _check_root(world, root)
 
     def impl(v):
         _check_concrete(v)
         vals = world.exchange(rank, ("Reduce_", op, root, _shape_sig(v)), v)
-        red = C.reduce_ordered(op, vals)
-        return red if rank == root else jnp.zeros_like(red)
+        # Non-root ranks discard the reduction, so they only compute it
+        # when the fold itself would raise (unsupported op) — keeping the
+        # informative rejection symmetric across ranks while skipping
+        # W-1 redundant memory-bound folds otherwise.
+        if rank == root or not C.fold_supported(op):
+            red = C.reduce_ordered(op, vals)
+            return red if rank == root else jnp.zeros_like(red)
+        return jnp.zeros_like(v)
 
     def bcast_impl(g):
         _check_concrete(g)
@@ -239,7 +266,7 @@ def reduce_(ctx: RankContext, x, op: int, root: int):
 
     f.defvjp(lambda v: (impl(v), None), bwd)
     out = f(x)
-    world.mark_consumed(x)
+    world.mark_consumed(rank, x)
     return out
 
 
@@ -288,8 +315,8 @@ def gather(ctx: RankContext, x, gatheraxis: int, root: int):
     shard sizes (reference: csrc/extension.cpp:497-599).  Adjoint:
     Scatter(grad, gatheraxis, numelem, root) with ``numelem`` = the local
     axis length captured at forward time (csrc/extension.cpp:503)."""
-    world = ctx.world
-    world.check_not_consumed(x)
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(rank, x)
     _check_root(world, root)
     ax = _norm_axis(gatheraxis, jnp.ndim(x))
     numelem = x.shape[ax]
@@ -314,7 +341,7 @@ def allgather(ctx: RankContext, x, gatheraxis: int):
     constant root=1, csrc/extension.cpp:627 — correct only for rank-uniform
     upstream gradients; see module docstring.)"""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     ax = _norm_axis(gatheraxis, jnp.ndim(x))
     numelem = x.shape[ax]
 
@@ -354,7 +381,7 @@ def scatter(ctx: RankContext, x, scatteraxis: int, numelem: int, root: int):
     the reference's JoinDummies(zeros, {gather}) trick
     (csrc/extension.cpp:756-766)."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     _check_root(world, root)
     in_shape, in_dtype = tuple(x.shape), jnp.asarray(x).dtype
 
@@ -381,7 +408,7 @@ def alltoall(ctx: RankContext, x, gatheraxis: int, scatteraxis: int, numelem: in
     axes-swapped Alltoall with ``numelem`` = the forward gather-axis local
     length (csrc/extension.cpp:912, captured at 923)."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     ga = _norm_axis(gatheraxis, jnp.ndim(x))
     back_numelem = x.shape[ga]
 
@@ -521,7 +548,7 @@ def isend(ctx: RankContext, x, dest: int, tag: int) -> List:
     is received inside this op's VJP (the analogue of
     MPINonBlockingBackward -> MPIWait, csrc/extension.cpp:1061-1069)."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     _check_tag(tag)
     dest = _resolve_peer(ctx, dest, "destination")
     req = world.new_request(REQ_ISEND, rank, dest, tag, tuple(x.shape),
@@ -559,7 +586,7 @@ def irecv(ctx: RankContext, x, source: int, tag: int) -> List:
     (overwritten) buffer; the gradient of the *received value* is sent back
     to ``source`` by Wait's VJP (csrc/extension.cpp:1209-1212)."""
     world, rank = ctx.world, ctx.rank
-    world.check_not_consumed(x)
+    world.check_not_consumed(rank, x)
     _check_tag(tag)
     source = _resolve_peer(ctx, source, "source")
     req = world.new_request(REQ_IRECV, rank, source, tag, tuple(x.shape),

@@ -122,7 +122,10 @@ class World:
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._pending: Dict[int, _PendingRequest] = {}
-        self._consumed: Dict[int, Any] = {}   # id(x) -> strong ref (in-place guard)
+        # (rank, id(x)) -> strong ref: per-rank in-place guard (see
+        # mark_consumed — ranks are threads, collectives may share one
+        # result object across them).
+        self._consumed: Dict[Tuple[int, int], Any] = {}
         self._failed = threading.Event()
         self._first_error: Optional[BaseException] = None
         self._err_lock = threading.Lock()
@@ -267,20 +270,25 @@ class World:
     # drops the strong ref that pinned the id).
     _CONSUMED_CAP = 4096
 
-    def mark_consumed(self, x: Any) -> None:
-        """Record ``x`` as consumed by an in-place collective.  The reference
-        splices an ``MPINoInplaceBackward`` node onto the *input* of Reduce_
-        so any later use raises at backward time (csrc/extension.cpp:395-403,
-        451-462).  Functionally-pure JAX has no aliasing hazard, so this is a
-        parity/discipline guard: later *communication* ops reject the value.
+    def mark_consumed(self, rank: int, x: Any) -> None:
+        """Record ``x`` as consumed by an in-place collective ON ``rank``.
+        The reference splices an ``MPINoInplaceBackward`` node onto the
+        *input* of Reduce_ so any later use raises at backward time
+        (csrc/extension.cpp:395-403, 451-462).  Functionally-pure JAX has
+        no aliasing hazard, so this is a parity/discipline guard: later
+        *communication* ops reject the value.  Keyed per rank because
+        ranks are threads sharing one process — collectives may hand the
+        SAME result object to every rank (Allreduce's fold-once path),
+        and rank r consuming its copy must not taint rank s's (in MPI
+        they would be distinct buffers in distinct processes).
         """
-        self._consumed[id(x)] = x  # strong ref pins id while tracked
+        self._consumed[(rank, id(x))] = x  # strong ref pins id while tracked
         while len(self._consumed) > self._CONSUMED_CAP:
             self._consumed.pop(next(iter(self._consumed)))
 
-    def check_not_consumed(self, *arrays: Any) -> None:
+    def check_not_consumed(self, rank: int, *arrays: Any) -> None:
         for a in arrays:
-            if id(a) in self._consumed:
+            if (rank, id(a)) in self._consumed:
                 raise InPlaceReuseError(
                     "Reuse of variables passed to in-place MPI kernels is not "
                     "supported (reference guard csrc/extension.cpp:451-462): "

@@ -107,3 +107,44 @@ def test_minloc_rejected_with_explanation():
             comm.Allreduce(jnp.ones(3), mpi.MPI_MINLOC)
 
     run_ranks(body, 2)
+
+
+def test_fold_once_result_consumption_is_per_rank():
+    # Above _FOLD_ONCE_MIN the eager Allreduce folds once on rank 0 and
+    # hands EVERY rank the same (immutable) result object.  The in-place
+    # consumed guard keys per (rank, id): rank 0 consuming its result via
+    # Reduce_ must not taint rank 1's use of the shared object (in MPI
+    # these would be distinct buffers in distinct processes).
+    from mpi4torch_tpu.ops import eager
+
+    n = eager._FOLD_ONCE_MIN
+
+    def body():
+        y = comm.Allreduce(jnp.ones(n), mpi.MPI_SUM)
+        if comm.rank == 0:
+            comm.Reduce_(y, mpi.MPI_SUM, 0)
+            # The guard raises BEFORE any rendezvous, so this is not a
+            # collective — rank 1 sees nothing.
+            with pytest.raises(mpi.InPlaceReuseError):
+                comm.Allreduce(y, mpi.MPI_SUM)
+            # Matching member of rank 1's final collective.
+            return comm.Allreduce(jnp.ones(n), mpi.MPI_SUM)
+        comm.Reduce_(jnp.ones(n), mpi.MPI_SUM, 0)
+        # Rank 1 never consumed y; using the shared object must stay
+        # legal even though rank 0 just consumed the same object.
+        return comm.Allreduce(y, mpi.MPI_SUM)
+
+    run_ranks(body, 2)
+
+
+def test_fold_once_unsupported_op_raises_on_every_rank():
+    # Unsupported reduction ops must keep the every-rank fold path above
+    # the fold-once threshold, so each rank raises the informative error
+    # (not a rank-0 death plus broken-barrier aborts elsewhere).
+    from mpi4torch_tpu.ops import eager
+
+    def body():
+        with pytest.raises(NotImplementedError, match="MAXLOC"):
+            comm.Allreduce(jnp.ones(eager._FOLD_ONCE_MIN), mpi.MPI_MAXLOC)
+
+    run_ranks(body, 2)
