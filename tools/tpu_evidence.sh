@@ -40,17 +40,32 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         echo "probe $n succeeded at $STAMP" | tee "$EV/00_probe.log"
         cat "$EV/probe_last.log" >>"$EV/00_probe.log"
 
+        # Commit after EVERY step, not once at the end: if the tunnel
+        # drops mid-pipeline, later steps sit in their (long) timeouts
+        # while the earlier steps' evidence would otherwise be
+        # uncommitted for hours.
+        step_commit() {
+            git add "$EV" >/dev/null 2>&1
+            git commit -m "On-chip evidence: $1 ($(date -u +%FT%TZ))
+
+No-Verification-Needed: telemetry/evidence logs only, no product code" \
+                >/dev/null 2>&1
+        }
+
         echo "=== make tpu-test @ $(date -u +%FT%TZ) ===" >"$EV/01_tpu_test.log"
         timeout 3600 make tpu-test >>"$EV/01_tpu_test.log" 2>&1
         echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/01_tpu_test.log"
+        step_commit "make tpu-test log"
 
         echo "=== bench.py @ $(date -u +%FT%TZ) ===" >"$EV/02_bench.log"
         timeout 5400 python bench.py >>"$EV/02_bench.log" 2>&1
         echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/02_bench.log"
+        step_commit "bench.py log"
 
         echo "=== bench_tradeoffs.py @ $(date -u +%FT%TZ) ===" >"$EV/03_tradeoffs.log"
         timeout 5400 python bench_tradeoffs.py >>"$EV/03_tradeoffs.log" 2>&1
         echo "rc=$? @ $(date -u +%FT%TZ)" >>"$EV/03_tradeoffs.log"
+        step_commit "bench_tradeoffs.py log"
 
         echo "evidence collected at $(date -u +%FT%TZ)" >"$EV/DONE"
 
