@@ -236,6 +236,68 @@ def bench_flash_tiling(n):
     return results
 
 
+def bench_vocab_chunk(n):
+    """Sweep the chunked-vocab CE chunk width at the bench train config
+    (``bench.py`` pins 4096 by analysis, never measured): time the full
+    loss fwd+bwd per chunk width, plus the dense head (vocab_chunk=0 —
+    the (batch, seq, vocab) logits it exists to avoid; may legitimately
+    OOM on chip, its own guard records that).  On CPU this is a harness
+    smoke at toy shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4torch_tpu.models import transformer as T
+
+    if _on_tpu():
+        cfg = T.TransformerConfig(vocab=32768, d_model=2048, n_heads=16,
+                                  n_layers=2, d_ff=8192, max_seq=2048)
+        batch, dtype, iters = 8, jnp.bfloat16, 5
+        sweep = (1024, 2048, 4096, 8192, 0)
+    else:
+        cfg = T.TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                  n_layers=1, d_ff=128, max_seq=64)
+        batch, dtype, iters = 2, jnp.float32, 2
+        sweep = (64, 0)
+
+    params = T.init_transformer(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch, cfg.max_seq), 0, cfg.vocab,
+                                jnp.int32)
+    results = []
+    ref_loss = None
+    for vc in sweep:
+        point = {"vocab_chunk": vc}
+        try:
+            step = jax.jit(jax.value_and_grad(
+                lambda p, _vc=vc: T.lm_loss(cfg, p, tokens,
+                                            vocab_chunk=_vc)))
+            # Correctness gate before the timing counts (the flash
+            # sweep's rule: a mis-lowering must never be reported as a
+            # fast configuration): every chunking computes the SAME
+            # mathematical loss — compare each point's value against the
+            # first successful one, at reduction-reassociation tolerance.
+            loss = float(step(params)[0])
+            point["loss"] = loss
+            if ref_loss is None:
+                ref_loss = loss
+            rel = abs(loss - ref_loss) / max(abs(ref_loss), 1e-30)
+            point["loss_rel_dev"] = rel
+            tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+            if rel > tol:
+                point["error"] = (f"loss deviates {rel:.2e} from the "
+                                  "sweep's reference — not timing a "
+                                  "mis-lowered configuration")
+                results.append(point)
+                _note(f"vocab_chunk {vc}: {point}")
+                continue
+            point["loss_fwd_bwd_s"] = _timeit(step, params, iters=iters)
+        except Exception as e:  # noqa: BLE001 — per-point guard (OOM etc.)
+            point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        results.append(point)
+        _note(f"vocab_chunk {vc}: {point}")
+    return results
+
+
 def bench_native_reduce_crossover(n):
     """``_NATIVE_REDUCE_MIN_SIZE``: the fused native C ordered fold vs the
     pure-jnp fold for CPU-RESIDENT operands (constants.py:102-104 — the
@@ -366,6 +428,7 @@ def main():
                      ("ordered_fold_paths", bench_ordered_fold_paths),
                      ("flash_tiling", bench_flash_tiling),
                      ("native_reduce_crossover", bench_native_reduce_crossover),
+                     ("vocab_chunk", bench_vocab_chunk),
                      ("reduce_scatter", bench_reduce_scatter)):
         try:
             result[name] = fn(n)
