@@ -20,7 +20,7 @@ EV=TPU_EVIDENCE
 mkdir -p "$EV"
 
 probe() {
-    JAX_PLATFORMS=tpu timeout 180 python - <<'EOF' >"$EV/probe_last.log" 2>&1
+    JAX_PLATFORMS=tpu timeout 120 python - <<'EOF' >"$EV/probe_last.log" 2>&1
 import jax, time
 t0 = time.time()
 ds = jax.devices()
@@ -106,7 +106,7 @@ No-Verification-Needed: telemetry/evidence logs only, no product code" \
         exit 0
     fi
     echo "probe $n failed at $(date -u +%FT%TZ)" >>"$EV/probe_history.log"
-    sleep 420
+    sleep 150
 done
 echo "deadline reached without a reachable TPU at $(date -u +%FT%TZ)" \
     >>"$EV/probe_history.log"
