@@ -75,7 +75,9 @@ No-Verification-Needed: telemetry/evidence logs only, no product code" \
             echo "# TPU evidence — round 5 (collected $STAMP)"
             echo
             echo "Collected unattended by tools/tpu_evidence.sh the moment"
-            echo "the tunnel came up.  Raw logs in TPU_EVIDENCE/."
+            echo "the tunnel came up.  Raw logs in TPU_EVIDENCE/; context"
+            echo "and history in ROUND5_NOTES.md (On-chip events);"
+            echo "tools/analyze_evidence.py digests the logs."
             echo
             echo "## Probe"
             echo '```'
