@@ -25,9 +25,11 @@ import json
 import sys
 
 
-# Share bench.py's timing rule (per-iteration completion barriers — the
-# round-3 postmortem's hard-won measurement contract) rather than copy it:
-# both harnesses must always measure under the same rules.
+# Share bench.py's timing rule (every timed iteration ends with a
+# device->host fetch of one element derived from every output leaf — the
+# round-3 AND round-5 postmortems' hard-won measurement contract; see
+# bench.py _force) rather than copy it: both harnesses must always
+# measure under the same rules.
 from bench import _timeit  # noqa: E402
 
 
