@@ -1,8 +1,11 @@
-"""Utilities: eager optimizers, checkpoint/resume, test helpers."""
+"""Utilities: eager optimizers, checkpoint/resume, input pipeline,
+test helpers."""
 
 from .checkpoint import CheckpointManager, restore_checkpoint, save_checkpoint
+from .data import prefetch_to_device, shard_batches, shard_batches_comm
 from .lbfgs import LBFGS, minimize_lbfgs
 from .profiling import profiler_trace
 
 __all__ = ["LBFGS", "minimize_lbfgs", "CheckpointManager",
-           "restore_checkpoint", "save_checkpoint", "profiler_trace"]
+           "restore_checkpoint", "save_checkpoint", "profiler_trace",
+           "shard_batches", "shard_batches_comm", "prefetch_to_device"]

@@ -1,0 +1,137 @@
+"""Input pipeline: deterministic per-rank sharding + device prefetch.
+
+The reference has no data loader (SURVEY.md §5); these test the
+framework's own.  Core properties: (1) the union of all ranks' batches
+at each step is a contiguous slice of one seeded global permutation —
+identical on every rank with no coordination; (2) shapes are static
+(remainder dropped) so every batch can feed one jitted step; (3)
+prefetching changes delivery, never values."""
+
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import COMM_WORLD as comm
+from mpi4torch_tpu.utils import (prefetch_to_device, shard_batches,
+                                 shard_batches_comm)
+
+
+def collect(rank, size, n=23, bs=3, **kw):
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    y = np.arange(n, dtype=np.int32)
+    return list(shard_batches((x, y), bs, rank=rank, size=size, **kw))
+
+
+class TestShardBatches:
+    def test_partition_of_global_permutation(self):
+        size, n, bs = 4, 23, 2
+        per_rank = [collect(r, size, n=n, bs=bs, seed=7) for r in range(size)]
+        steps = n // (bs * size)
+        assert all(len(b) == steps for b in per_rank)
+        order = np.random.default_rng((7, 0)).permutation(n)
+        seen = []
+        for s in range(steps):
+            step_labels = np.concatenate(
+                [per_rank[r][s][1] for r in range(size)])
+            # Union over ranks at step s == the next contiguous slice of
+            # the global permutation, in rank order.
+            want = order[s * bs * size:(s + 1) * bs * size]
+            np.testing.assert_array_equal(step_labels, want)
+            seen.extend(step_labels)
+        assert len(set(seen)) == len(seen)          # disjoint
+
+    def test_features_follow_labels(self):
+        for r in range(3):
+            for x, y in collect(r, 3, seed=11):
+                np.testing.assert_array_equal(x[:, 0], 2.0 * y)
+
+    def test_epoch_changes_order_deterministically(self):
+        a = collect(0, 2, seed=3, epoch=0)
+        b = collect(0, 2, seed=3, epoch=1)
+        a2 = collect(0, 2, seed=3, epoch=0)
+        assert any((x[1] != y[1]).any() for x, y in zip(a, b))
+        for (xa, ya), (xc, yc) in zip(a, a2):
+            np.testing.assert_array_equal(ya, yc)
+
+    def test_no_shuffle_is_sequential(self):
+        (x0, y0), (x1, y1) = collect(0, 2, n=8, bs=2, shuffle=False)
+        np.testing.assert_array_equal(y0, [0, 1])   # rank 0, steps 0..1
+        np.testing.assert_array_equal(y1, [4, 5])
+        (_, z0), (_, z1) = collect(1, 2, n=8, bs=2, shuffle=False)
+        np.testing.assert_array_equal(z0, [2, 3])
+        np.testing.assert_array_equal(z1, [6, 7])
+
+    def test_static_shapes_remainder_dropped(self):
+        batches = collect(0, 3, n=23, bs=3)
+        assert len(batches) == 23 // 9
+        assert all(x.shape == (3, 2) and y.shape == (3,)
+                   for x, y in batches)
+
+    def test_single_array_input(self):
+        out = list(shard_batches(np.arange(10), 2, rank=0, size=1,
+                                 shuffle=False))
+        assert len(out) == 5 and not isinstance(out[0], tuple)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="leading axes"):
+            list(shard_batches((np.zeros(3), np.zeros(4)), 1))
+        with pytest.raises(ValueError, match="batch_size"):
+            list(shard_batches(np.zeros(3), 0))
+        with pytest.raises(ValueError, match="out of range"):
+            list(shard_batches(np.zeros(3), 1, rank=2, size=2))
+
+
+class TestCommIntegration:
+    def test_eager_ranks_partition(self):
+        n, bs = 16, 2
+
+        def body():
+            x = np.arange(n, dtype=np.float32)
+            got = [b for b in shard_batches_comm(x, bs, comm, seed=5,
+                                                 shuffle=False)]
+            return np.concatenate(got)
+
+        outs = mpi.run_ranks(body, 4)
+        allv = np.concatenate([np.asarray(o) for o in outs])
+        assert sorted(allv.tolist()) == list(range(n))
+
+    def test_spmd_comm_rejected(self):
+        # Under run_spmd the rank is traced; the helper must refuse
+        # loudly rather than mis-shard.
+        def body(x):
+            c = mpi.COMM_WORLD
+            try:
+                shard_batches_comm(np.arange(8.0), 2, c)
+            except TypeError:
+                return x
+            raise AssertionError("traced rank accepted")
+
+        mpi.run_spmd(body, nranks=2)(np.ones(1))
+
+
+class TestPrefetch:
+    def test_values_and_order_unchanged(self):
+        import jax.numpy as jnp
+
+        src = [(np.full((2,), i), np.int32(i)) for i in range(7)]
+        got = list(prefetch_to_device(iter(src), size=3))
+        assert len(got) == 7
+        for i, (a, b) in enumerate(got):
+            assert isinstance(a, jnp.ndarray)
+            np.testing.assert_array_equal(np.asarray(a), src[i][0])
+            assert int(b) == i
+
+    def test_size_one_and_validation(self):
+        assert len(list(prefetch_to_device(iter([1, 2]), size=1))) == 2
+        with pytest.raises(ValueError, match="prefetch size"):
+            list(prefetch_to_device(iter([]), size=0))
+
+    def test_composes_with_shard_batches(self):
+        x = np.arange(12, dtype=np.float32)
+        out = list(prefetch_to_device(
+            shard_batches(x, 2, rank=1, size=2, shuffle=False)))
+        np.testing.assert_array_equal(np.asarray(out[0]), [2.0, 3.0])
+
+    def test_empty_epoch_raises(self):
+        with pytest.raises(ValueError, match="zero steps"):
+            list(shard_batches(np.zeros(5), 2, rank=0, size=4))
