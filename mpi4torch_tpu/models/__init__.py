@@ -9,6 +9,6 @@ executable documentation of each parallelism strategy (SURVEY.md §2.5) and
 as the flagship programs for the benchmark/graft entry points.
 """
 
-from . import mlp, resnet, transformer
+from . import mlp, resnet, transformer, vit
 
-__all__ = ["mlp", "resnet", "transformer"]
+__all__ = ["mlp", "resnet", "transformer", "vit"]

@@ -1,0 +1,137 @@
+"""Vision Transformer — the model zoo's non-causal attention family.
+
+The reference ships no models (SURVEY.md §0); this family exists to
+exercise the framework surface the causal LM flagship cannot: the flash
+kernels' NON-causal path inside a full model (every KV tile live for
+every query tile — no diagonal cut), image patchification as pure
+reshape/transpose + one MXU matmul (no gather), and the same DP recipe
+as the ResNet family over the communicator ops.
+
+TPU notes: patches are embedded by ONE (b*n_patches, p*p*c) @
+(p*p*c, d) matmul — patchify itself is a free relayout, the compiler
+fuses it into the projection's operand load.  Attention runs through
+:func:`ops.flash.flash_attention` with ``causal=False``: eligible
+shapes take the Pallas kernel, everything else the jnp blockwise path,
+identically to the LM flagship.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import MPI_SUM
+from ..ops.flash import flash_attention
+from .transformer import _layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_hw: int
+    patch: int
+    d_model: int
+    n_heads: int
+    n_layers: int
+    d_ff: int
+    num_classes: int
+    channels: int = 3
+
+    def __post_init__(self):
+        if self.image_hw % self.patch != 0:
+            raise ValueError(
+                f"image_hw={self.image_hw} not divisible by "
+                f"patch={self.patch}")
+        if self.d_model % self.n_heads != 0:
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by "
+                f"n_heads={self.n_heads}")
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_hw // self.patch) ** 2
+
+
+def init_vit(key, cfg: ViTConfig, dtype=jnp.float32) -> Dict[str, Any]:
+    """Parameter pytree for a pre-LN ViT with learned positions and a
+    mean-pool classification head."""
+    def dense(key, m, n):
+        return jax.random.normal(key, (m, n), dtype) / jnp.sqrt(
+            jnp.asarray(m, dtype))
+
+    def norm_p():
+        return {"scale": jnp.ones((cfg.d_model,), dtype),
+                "bias": jnp.zeros((cfg.d_model,), dtype)}
+
+    keys = iter(jax.random.split(key, 4 + 4 * cfg.n_layers))
+    pdim = cfg.patch * cfg.patch * cfg.channels
+    params: Dict[str, Any] = {
+        "patch_proj": dense(next(keys), pdim, cfg.d_model),
+        "pos": jax.random.normal(
+            next(keys), (cfg.n_patches, cfg.d_model), dtype) * 0.02,
+        "ln_f": norm_p(),
+        "head": dense(next(keys), cfg.d_model, cfg.num_classes),
+        "blocks": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["blocks"].append({
+            "ln1": norm_p(),
+            "wqkv": dense(next(keys), cfg.d_model, 3 * cfg.d_model),
+            "wo": dense(next(keys), cfg.d_model, cfg.d_model),
+            "ln2": norm_p(),
+            "w1": dense(next(keys), cfg.d_model, cfg.d_ff),
+            "w2": dense(next(keys), cfg.d_ff, cfg.d_model),
+        })
+    return params
+
+
+def patchify(cfg: ViTConfig, images):
+    """(b, hw, hw, c) -> (b, n_patches, patch*patch*c), rows in raster
+    order.  Pure reshape/transpose — XLA folds it into the projection."""
+    b = images.shape[0]
+    g, p, c = cfg.image_hw // cfg.patch, cfg.patch, cfg.channels
+    x = images.reshape(b, g, p, g, p, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, p * p * c)
+
+
+def forward(cfg: ViTConfig, params, images):
+    """Logits ``(b, num_classes)``."""
+    x = patchify(cfg, images) @ params["patch_proj"] + params["pos"]
+    b, s, d = x.shape
+    hd = d // cfg.n_heads
+    for blk in params["blocks"]:
+        y = _layer_norm(x, blk["ln1"])
+        qkv = y @ blk["wqkv"]
+        q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(
+            b, s, cfg.n_heads, hd) for i in range(3))
+        att = flash_attention(q, k, v, causal=False)
+        x = x + att.reshape(b, s, d) @ blk["wo"]
+        y = _layer_norm(x, blk["ln2"])
+        x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    x = _layer_norm(x, params["ln_f"])
+    return jnp.mean(x, axis=1) @ params["head"]
+
+
+def local_loss(cfg: ViTConfig, params, batch):
+    """Mean softmax cross-entropy on the rank-local batch."""
+    images, labels = batch
+    logp = jax.nn.log_softmax(forward(cfg, params, images), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
+                                         axis=-1)[:, 0])
+
+
+def dp_grad_train_step(comm, cfg: ViTConfig, params, batch,
+                       lr: float = 0.1):
+    """One SGD step with the classic DDP recipe: local backward, then one
+    ``Allreduce(g, MPI_SUM)/size`` per gradient (the resnet family's
+    recipe, reference doc/examples.rst:46-65 discipline).  Returns
+    ``(global_loss, new_params)``."""
+    loss, grads = jax.value_and_grad(
+        lambda p: local_loss(cfg, p, batch))(params)
+    size = comm.size
+    grads = jax.tree.map(lambda g: comm.Allreduce(g, MPI_SUM) / size, grads)
+    global_loss = comm.Allreduce(loss, MPI_SUM) / size
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return global_loss, new_params

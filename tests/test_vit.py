@@ -1,0 +1,127 @@
+"""ViT family: non-causal flash attention inside a full model.
+
+Oracle discipline matches the other families: the model forward must
+equal a naive dense-softmax re-implementation exactly (the attention
+dispatch may pick any path — jnp blockwise here on the CPU harness —
+and none may drift from dense attention), DP training must stay in
+lock-step and match the single-process global-batch trajectory."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import COMM_WORLD as comm
+from mpi4torch_tpu.models import vit as V
+from mpi4torch_tpu.models.transformer import _layer_norm
+
+CFG = V.ViTConfig(image_hw=8, patch=4, d_model=16, n_heads=2,
+                  n_layers=2, d_ff=32, num_classes=5)
+
+
+def images_labels(n, cfg=CFG, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(
+        (n, cfg.image_hw, cfg.image_hw, cfg.channels)), jnp.float64)
+    y = jnp.asarray(rng.integers(0, cfg.num_classes, n), jnp.int32)
+    return x, y
+
+
+def naive_forward(cfg, params, images):
+    """Dense-softmax reference, structurally independent of the model's
+    attention dispatch."""
+    x = V.patchify(cfg, images) @ params["patch_proj"] + params["pos"]
+    b, s, d = x.shape
+    hd = d // cfg.n_heads
+    for blk in params["blocks"]:
+        y = _layer_norm(x, blk["ln1"])
+        qkv = y @ blk["wqkv"]
+        q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(
+            b, s, cfg.n_heads, hd) for i in range(3))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(hd, x.dtype))
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        x = x + att.reshape(b, s, d) @ blk["wo"]
+        y = _layer_norm(x, blk["ln2"])
+        x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    x = _layer_norm(x, params["ln_f"])
+    return jnp.mean(x, axis=1) @ params["head"]
+
+
+class TestForward:
+    def test_matches_naive_dense_oracle(self):
+        params = V.init_vit(jax.random.PRNGKey(0), CFG, dtype=jnp.float64)
+        x, _ = images_labels(3)
+        got = V.forward(CFG, params, x)
+        want = naive_forward(CFG, params, x)
+        assert got.shape == (3, CFG.num_classes)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_grads_match_naive_oracle(self):
+        params = V.init_vit(jax.random.PRNGKey(1), CFG, dtype=jnp.float64)
+        x, y = images_labels(2, seed=3)
+
+        def loss(fwd):
+            def f(p):
+                logp = jax.nn.log_softmax(fwd(CFG, p, x), axis=-1)
+                return -jnp.mean(jnp.take_along_axis(
+                    logp, y[:, None], axis=-1))
+            return f
+
+        g1 = jax.grad(loss(V.forward))(params)
+        g2 = jax.grad(loss(naive_forward))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-8, atol=1e-10), g1, g2)
+
+    def test_patchify_raster_order(self):
+        cfg = V.ViTConfig(image_hw=4, patch=2, d_model=8, n_heads=1,
+                          n_layers=1, d_ff=8, num_classes=2, channels=1)
+        img = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        p = V.patchify(cfg, img)
+        # Patch (0,0) holds rows 0-1 x cols 0-1 of the image.
+        np.testing.assert_array_equal(np.asarray(p[0, 0]), [0, 1, 4, 5])
+        np.testing.assert_array_equal(np.asarray(p[0, 3]), [10, 11, 14, 15])
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="not divisible by patch"):
+            V.ViTConfig(image_hw=9, patch=4, d_model=16, n_heads=2,
+                        n_layers=1, d_ff=16, num_classes=2)
+        with pytest.raises(ValueError, match="not divisible by n_heads"):
+            V.ViTConfig(image_hw=8, patch=4, d_model=15, n_heads=2,
+                        n_layers=1, d_ff=16, num_classes=2)
+
+
+class TestDP:
+    @pytest.mark.parametrize("nranks", [2, 4])
+    def test_lockstep_matches_single_process(self, nranks):
+        params0 = V.init_vit(jax.random.PRNGKey(2), CFG, dtype=jnp.float64)
+        x, y = images_labels(2 * nranks, seed=5)
+        bl = 2
+
+        # Single-process oracle on the global batch.
+        ref_p = params0
+        for _ in range(2):
+            loss, grads = jax.value_and_grad(
+                lambda p: V.local_loss(CFG, p, (x, y)))(ref_p)
+            ref_p = jax.tree.map(lambda p, g: p - 0.1 * g, ref_p, grads)
+
+        def body():
+            p = params0
+            r = comm.rank
+            batch = (x[r * bl:(r + 1) * bl], y[r * bl:(r + 1) * bl])
+            for _ in range(2):
+                loss, p = V.dp_grad_train_step(comm, CFG, p, batch, lr=0.1)
+            return loss, p["head"]
+
+        outs = mpi.run_ranks(body, nranks)
+        h0 = np.asarray(outs[0][1])
+        assert all(np.array_equal(h0, np.asarray(h)) for _, h in outs[1:])
+        # Mean-of-local-means == global mean only with equal shards (they
+        # are); the distributed trajectory then matches single-process to
+        # reassociation noise.
+        np.testing.assert_allclose(h0, np.asarray(ref_p["head"]),
+                                   rtol=1e-9, atol=1e-11)
