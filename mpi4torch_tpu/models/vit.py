@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..constants import MPI_SUM
 from ..ops.flash import flash_attention
+from ..parallel.attention import ring_attention
 from .transformer import _layer_norm
 
 
@@ -97,8 +98,41 @@ def patchify(cfg: ViTConfig, images):
 
 
 def forward(cfg: ViTConfig, params, images):
-    """Logits ``(b, num_classes)``."""
-    x = patchify(cfg, images) @ params["patch_proj"] + params["pos"]
+    """Logits ``(b, num_classes)`` (single-device attention).
+
+    For patch parallelism — the non-causal face of context
+    parallelism — shard the PATCHIFIED input across ranks and call
+    :func:`forward_patches` with ``comm_sp`` instead: a whole-image
+    ``forward`` has no valid sharded reading (each rank's ring
+    contribution must be a distinct shard of ONE global patch
+    sequence, not its own full image)."""
+    return forward_patches(cfg, params, patchify(cfg, images))
+
+
+def forward_patches(cfg: ViTConfig, params, patches, comm_sp=None,
+                    patch_offset=None):
+    """Forward from patchified input, optionally patch-sharded.
+
+    With ``comm_sp``, each rank holds the contiguous equal shard
+    ``(b, n_patches/size, patch*patch*c)`` of one global patch
+    sequence in rank order (the layout ring attention fixes);
+    attention runs as NON-causal ring attention over the shard ring
+    (every query sees every key — no diagonal cut, so the ring is
+    naturally load-balanced and needs no zigzag layout) and the
+    mean-pool head closes with one ``Allreduce``.  The positional rows
+    for the shard are derived from ``comm_sp.rank`` (works traced
+    under SPMD); ``patch_offset`` overrides the derivation only."""
+    sp = comm_sp is not None and comm_sp.size > 1
+    pos = params["pos"]
+    if sp:
+        if patch_offset is None:
+            # The ring layout pins shard r's first global patch at
+            # r * s_local; deriving it here removes the silently-wrong
+            # default-0 positional rows a forgetful caller would get.
+            patch_offset = jnp.asarray(comm_sp.rank) * patches.shape[1]
+        pos = jax.lax.dynamic_slice_in_dim(
+            pos, patch_offset, patches.shape[1], 0)
+    x = patches @ params["patch_proj"] + pos
     b, s, d = x.shape
     hd = d // cfg.n_heads
     for blk in params["blocks"]:
@@ -106,12 +140,19 @@ def forward(cfg: ViTConfig, params, images):
         qkv = y @ blk["wqkv"]
         q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(
             b, s, cfg.n_heads, hd) for i in range(3))
-        att = flash_attention(q, k, v, causal=False)
+        if sp:
+            att = ring_attention(comm_sp, q, k, v, causal=False)
+        else:
+            att = flash_attention(q, k, v, causal=False)
         x = x + att.reshape(b, s, d) @ blk["wo"]
         y = _layer_norm(x, blk["ln2"])
         x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
     x = _layer_norm(x, params["ln_f"])
-    return jnp.mean(x, axis=1) @ params["head"]
+    pooled = jnp.mean(x, axis=1)
+    if sp:
+        # Mean over the full patch axis = mean of equal-shard means.
+        pooled = comm_sp.Allreduce(pooled, MPI_SUM) / comm_sp.size
+    return pooled @ params["head"]
 
 
 def local_loss(cfg: ViTConfig, params, batch):
