@@ -194,3 +194,15 @@ def test_zero_sharded_optimizer():
     got, ref = mod.main(4)
     np.testing.assert_allclose(np.asarray(got["b"]), np.asarray(ref["b"]),
                                rtol=1e-9)
+
+
+def test_vit_patch_parallel():
+    # DP ViT training + patch-parallel (non-causal ring attention)
+    # inference matching the single-process forward.
+    mod = _load("vit_patch_parallel")
+    results = mpi.run_ranks(lambda: mod.main(steps=2), 2)
+    losses0, head0, shard0, single0 = results[0]
+    for _, h, sh, si in results:
+        np.testing.assert_array_equal(head0, h)
+        np.testing.assert_allclose(sh, si, rtol=1e-5, atol=1e-6)
+    assert losses0[-1] < losses0[0]
