@@ -62,6 +62,11 @@ def main(steps: int = 3, cfg: V.ViTConfig = CFG, batch_per_rank: int = 2):
         losses.append(float(loss))
 
     # Phase 2: classify with the patch axis sharded over the ranks.
+    if cfg.n_patches % comm.size != 0:
+        raise ValueError(
+            f"patch parallelism needs the {cfg.n_patches} patches to "
+            f"split evenly over {comm.size} ranks — run with a divisor "
+            "rank count (ring attention's equal-shard layout)")
     images = jnp.asarray(data[0][:batch_per_rank])
     patches = V.patchify(cfg, images)
     sl = cfg.n_patches // comm.size
