@@ -580,7 +580,11 @@ def _mask_to_root(ctx: SpmdContext, x, root: int):
 # choice is per-callsite and compiles to exactly one strategy).
 # bench_tradeoffs.py sweeps both lowerings head-to-head across the
 # threshold on whatever hardware is attached — re-run it on a real chip
-# to recalibrate this constant.
+# to recalibrate this constant.  Calibration NEEDS n > 1 devices: on a
+# single chip both lowerings degenerate to identity (a 1-rank Bcast has
+# no wire), so the one-chip environment available through round 5 can
+# never measure this crossover — the sweep is armed for the first
+# multi-chip run.
 _BCAST_TREE_MAX_BYTES = 256 * 1024
 
 
