@@ -1,0 +1,116 @@
+"""Data-parallel training with compressed gradient sync.
+
+The shipped linear-regression recipe (examples/simple_linear_regression.py)
+with the gradient AllReduce riding the quantized wire
+(doc/compression.md): three runs of the same SGD loop —
+
+  1. exact fp32 gradient sync (the baseline),
+  2. ``compression="q8_ef"`` — block-scaled int8 with an in-call
+     error-feedback round (~2x fewer bytes on the wire, second-order
+     error),
+  3. single-round ``q8`` (~3.94x fewer bytes) with the residual carried
+     ACROSS steps via ``compress.ef_init``/``ef_allreduce`` (EF-SGD).
+
+All three converge to the same loss (the acceptance gate in
+tests/test_compress.py requires the compressed runs within 2% of fp32);
+the printout shows the final losses and the per-step gradient bytes each
+variant puts on the wire.
+
+Run:  python examples/compressed_data_parallel.py [nranks]
+(the thread-SPMD launcher replaces ``mpirun -np N``; the identical loss
+function runs compiled over a TPU mesh under ``mpi.run_spmd``)
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+if os.environ.get("MPI4TORCH_TPU_REAL_DEVICES") != "1":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu.compress import ef_allreduce, ef_init, get_codec
+
+comm = mpi.COMM_WORLD
+
+NUM_POINTS = 512
+STEPS = 150
+LR = 0.1
+
+
+def some_parametrized_function(inp, params):
+    return (params[2] * inp + params[1]) * inp + params[0]
+
+
+def _shard(rank, size):
+    rng = np.random.default_rng(42)
+    x = 2.0 * rng.random(NUM_POINTS)
+    gen = np.asarray([0.1, 1.0, -2.0])
+    y = some_parametrized_function(x, gen) \
+        + 0.05 * rng.standard_normal(NUM_POINTS)
+    n = NUM_POINTS // size
+    lo = rank * n
+    return jnp.asarray(x[lo:lo + n]), jnp.asarray(y[lo:lo + n])
+
+
+def train(compression=False, stateful_ef=False):
+    """One SGD run; returns (final global loss, params)."""
+    xs, ys = _shard(comm.rank, comm.size)
+
+    def local_loss(p):
+        pred = some_parametrized_function(xs, p)
+        return jnp.mean(jnp.square(ys - pred)) / comm.size
+
+    params = jnp.zeros(3, jnp.float64)
+    resid = ef_init(params)
+    for _ in range(STEPS):
+        g = jax.grad(local_loss)(params)
+        if stateful_ef:
+            # Residual carried across steps: single-round q8 wire, the
+            # untransmitted error re-enters next step's gradient.
+            g, resid = ef_allreduce(comm, g, resid, compression=compression)
+        else:
+            g = comm.Allreduce(g, mpi.MPI_SUM, compression=compression)
+        params = params - LR * g
+    return float(comm.Allreduce(local_loss(params), mpi.MPI_SUM)), params
+
+
+def main():
+    fp32_loss, fp32_params = train(compression=False)
+    ef_loss, _ = train(compression="q8_ef")
+    st_loss, _ = train(compression="q8", stateful_ef=True)
+
+    if comm.rank == 0:
+        # Wire accounting at a model-scale gradient (1 Mi f32 elements);
+        # this example's 3-entry gradient is block-padding-dominated and
+        # would misrepresent the asymptotic ratio.
+        nelem = 1 << 20
+        fp32_bytes = nelem * 4
+        rows = [
+            ("fp32 (exact)", fp32_loss, 1.0),
+            ("q8_ef (in-call EF)", ef_loss,
+             fp32_bytes / get_codec("q8_ef").wire_bytes((nelem,),
+                                                        jnp.float32)),
+            ("q8 + carried EF", st_loss,
+             fp32_bytes / get_codec("q8").wire_bytes((nelem,),
+                                                     jnp.float32)),
+        ]
+        print(f"{'gradient sync':<22} {'final loss':>12} "
+              f"{'wire reduction':>15}")
+        for name, loss, ratio in rows:
+            print(f"{name:<22} {loss:>12.6f} {ratio:>14.2f}x")
+        print("params (fp32 run):", np.asarray(fp32_params))
+    return fp32_loss, ef_loss, st_loss
+
+
+if __name__ == "__main__":
+    nranks = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    mpi.run_ranks(main, nranks)

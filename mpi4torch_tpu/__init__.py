@@ -60,6 +60,8 @@ from .distributed import (
     local_values,
 )
 from . import config
+from . import compress
+from .config import compression_scope
 
 __all__ = [
     # reference __all__ (src/__init__.py:5-25)
@@ -98,6 +100,8 @@ __all__ = [
     "RankExpr",
     "PermRank",
     "config",
+    "compress",
+    "compression_scope",
     "CommError",
     "CollectiveMismatchError",
     "DeadlockError",

@@ -1,0 +1,105 @@
+"""Version tolerance for public jax APIs that moved between releases.
+
+The framework targets the public jax surface only, but two pieces of that
+surface moved underneath us:
+
+* ``shard_map`` — top-level ``jax.shard_map`` first appears in jax 0.6;
+  before that it lives at ``jax.experimental.shard_map.shard_map``.
+* its replication-check kwarg — renamed ``check_rep`` -> ``check_vma``
+  across the same boundary.
+
+Everything in this repo (ops/spmd.py, tests, the graft entry point) goes
+through :func:`shard_map` below, which resolves the import once and maps
+the kwarg to whatever the installed jax calls it.  Keeping the shim in one
+module means a future rename costs a one-line fix instead of a sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["shard_map", "lowered_text", "optimization_barrier",
+           "tpu_compiler_params"]
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across its rename: older jax calls the
+    same dataclass ``TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
+@functools.lru_cache(maxsize=1)
+def _native_barrier_differentiates() -> bool:
+    import jax
+
+    try:
+        jax.grad(lambda x: jax.lax.optimization_barrier(x))(1.0)
+        return True
+    except NotImplementedError:
+        return False
+
+
+def optimization_barrier(x):
+    """``lax.optimization_barrier`` that is reverse-differentiable on
+    every supported jax: newer releases ship a differentiation rule
+    (cotangents pass through their own barrier); older ones get the same
+    semantics via a ``custom_vjp`` wrapper."""
+    import jax
+
+    if _native_barrier_differentiates():
+        return jax.lax.optimization_barrier(x)
+
+    @jax.custom_vjp
+    def barrier(v):
+        return jax.lax.optimization_barrier(v)
+
+    barrier.defvjp(lambda v: (jax.lax.optimization_barrier(v), None),
+                   lambda _, g: (jax.lax.optimization_barrier(g),))
+    return barrier(x)
+
+
+def lowered_text(lowered, debug_info: bool = False) -> str:
+    """``jax.stages.Lowered.as_text`` with the ``debug_info`` kwarg
+    normalized: older jax exposes the loc()/name-stack metadata only
+    through the MLIR module's ``get_asm(enable_debug_info=True)``."""
+    try:
+        return lowered.as_text(debug_info=debug_info)
+    except TypeError:
+        if not debug_info:
+            return lowered.as_text()
+        ir = lowered.compiler_ir(dialect="stablehlo")
+        return ir.operation.get_asm(enable_debug_info=True,
+                                    large_elements_limit=32)
+
+
+@functools.lru_cache(maxsize=1)
+def _resolve():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    params = frozenset(inspect.signature(fn).parameters)
+    return fn, params
+
+
+def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+              check_vma=None, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg normalized.
+
+    ``check_vma`` is the current name; on older jax it is forwarded as
+    ``check_rep`` (same meaning).  All other arguments pass through."""
+    fn, params = _resolve()
+    kw = dict(kwargs)
+    if mesh is not None:
+        kw["mesh"] = mesh
+    kw["in_specs"] = in_specs
+    kw["out_specs"] = out_specs
+    if check_vma is not None:
+        kw["check_vma" if "check_vma" in params else "check_rep"] = check_vma
+    return fn(f, **kw)

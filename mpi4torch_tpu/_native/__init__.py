@@ -66,7 +66,9 @@ def _load() -> Optional[ctypes.CDLL]:
     for name in ("ordered_reduce_f32", "ordered_reduce_f64",
                  "ordered_reduce_i32", "ordered_reduce_i64"):
         fn = getattr(lib, name)
-        fn.restype = None
+        # 0 = folded; nonzero = op not handled for this dtype family
+        # (caller falls back to the jnp fold — see native.cc).
+        fn.restype = ctypes.c_int32
         fn.argtypes = [ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
                        ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p]
     return lib
@@ -127,6 +129,13 @@ def ordered_reduce(arrays: List[np.ndarray], op: int) -> Optional[np.ndarray]:
     out = np.empty_like(bufs[0])
     ptrs = (ctypes.c_void_p * len(bufs))(
         *[b.ctypes.data_as(ctypes.c_void_p).value for b in bufs])
-    getattr(_lib, fname)(ptrs, len(bufs), a0.size, op,
-                         out.ctypes.data_as(ctypes.c_void_p))
+    rc = getattr(_lib, fname)(ptrs, len(bufs), a0.size, op,
+                              out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        # Op code not handled by the native kernel for this dtype family
+        # (e.g. a code added Python-side without a matching native case):
+        # report unavailable instead of an identity "reduction"
+        # (ADVICE r5 — native.cc previously folded unknown ops to rank-0's
+        # buffer silently).
+        return None
     return out

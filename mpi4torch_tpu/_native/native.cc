@@ -142,32 +142,62 @@ void ordered_reduce_fixed(const T* const* bufs, int32_t nbufs, int64_t n,
   }
 }
 
+// Dispatch returns 0 when the op was folded and 1 ("not handled") for any
+// op code the combiner cannot evaluate — including codes added on the
+// Python side without a matching native case.  The previous default case
+// instantiated Combine's identity and silently returned rank-0's buffer
+// as the "reduction" (ADVICE r5); the Python wrapper treats the sentinel
+// as "fall back to the jnp fold", so an op/kernel mismatch degrades to
+// the slow-but-correct path instead of to wrong data.  The arithmetic
+// combiner (floats) handles SUM/PROD/MAX/MIN only; the integer combiner
+// additionally handles the logical/bitwise ops — mirroring the op/dtype
+// gate in _native/__init__.py (and MPI's own op/dtype table, reference
+// csrc/extension.cpp:106-129).
 template <typename T, T (*Combine)(int32_t, T, T)>
-void ordered_reduce(const T* const* bufs, int32_t nbufs, int64_t n,
-                    int32_t op, T* out) {
+int32_t ordered_reduce_arith(const T* const* bufs, int32_t nbufs, int64_t n,
+                             int32_t op, T* out) {
   switch (op) {
     case OP_SUM:
-      return ordered_reduce_fixed<T, Combine, OP_SUM>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_SUM>(bufs, nbufs, n, out);
+      return 0;
     case OP_PROD:
-      return ordered_reduce_fixed<T, Combine, OP_PROD>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_PROD>(bufs, nbufs, n, out);
+      return 0;
     case OP_MAX:
-      return ordered_reduce_fixed<T, Combine, OP_MAX>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_MAX>(bufs, nbufs, n, out);
+      return 0;
     case OP_MIN:
-      return ordered_reduce_fixed<T, Combine, OP_MIN>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_MIN>(bufs, nbufs, n, out);
+      return 0;
+    default:
+      return 1;  // not handled: caller must use the fallback fold
+  }
+}
+
+template <typename T, T (*Combine)(int32_t, T, T)>
+int32_t ordered_reduce_integer(const T* const* bufs, int32_t nbufs,
+                               int64_t n, int32_t op, T* out) {
+  switch (op) {
     case OP_LAND:
-      return ordered_reduce_fixed<T, Combine, OP_LAND>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_LAND>(bufs, nbufs, n, out);
+      return 0;
     case OP_BAND:
-      return ordered_reduce_fixed<T, Combine, OP_BAND>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_BAND>(bufs, nbufs, n, out);
+      return 0;
     case OP_LOR:
-      return ordered_reduce_fixed<T, Combine, OP_LOR>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_LOR>(bufs, nbufs, n, out);
+      return 0;
     case OP_BOR:
-      return ordered_reduce_fixed<T, Combine, OP_BOR>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_BOR>(bufs, nbufs, n, out);
+      return 0;
     case OP_LXOR:
-      return ordered_reduce_fixed<T, Combine, OP_LXOR>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_LXOR>(bufs, nbufs, n, out);
+      return 0;
     case OP_BXOR:
-      return ordered_reduce_fixed<T, Combine, OP_BXOR>(bufs, nbufs, n, out);
-    default:  // validated on the Python side; Combine's default is identity
-      return ordered_reduce_fixed<T, Combine, 0>(bufs, nbufs, n, out);
+      ordered_reduce_fixed<T, Combine, OP_BXOR>(bufs, nbufs, n, out);
+      return 0;
+    default:
+      return ordered_reduce_arith<T, Combine>(bufs, nbufs, n, op, out);
   }
 }
 
@@ -175,24 +205,32 @@ void ordered_reduce(const T* const* bufs, int32_t nbufs, int64_t n,
 
 extern "C" {
 
-void ordered_reduce_f32(const float* const* bufs, int32_t nbufs, int64_t n,
-                        int32_t op, float* out) {
-  ordered_reduce<float, combine_arith<float>>(bufs, nbufs, n, op, out);
+// Entry points return 0 on success, nonzero when the op code is not
+// handled for this dtype family (the Python wrapper falls back to the
+// jnp fold on nonzero — see _native/__init__.py ordered_reduce).
+
+int32_t ordered_reduce_f32(const float* const* bufs, int32_t nbufs,
+                           int64_t n, int32_t op, float* out) {
+  return ordered_reduce_arith<float, combine_arith<float>>(bufs, nbufs, n,
+                                                           op, out);
 }
 
-void ordered_reduce_f64(const double* const* bufs, int32_t nbufs, int64_t n,
-                        int32_t op, double* out) {
-  ordered_reduce<double, combine_arith<double>>(bufs, nbufs, n, op, out);
+int32_t ordered_reduce_f64(const double* const* bufs, int32_t nbufs,
+                           int64_t n, int32_t op, double* out) {
+  return ordered_reduce_arith<double, combine_arith<double>>(bufs, nbufs, n,
+                                                             op, out);
 }
 
-void ordered_reduce_i32(const int32_t* const* bufs, int32_t nbufs, int64_t n,
-                        int32_t op, int32_t* out) {
-  ordered_reduce<int32_t, combine_int<int32_t>>(bufs, nbufs, n, op, out);
+int32_t ordered_reduce_i32(const int32_t* const* bufs, int32_t nbufs,
+                           int64_t n, int32_t op, int32_t* out) {
+  return ordered_reduce_integer<int32_t, combine_int<int32_t>>(bufs, nbufs,
+                                                               n, op, out);
 }
 
-void ordered_reduce_i64(const int64_t* const* bufs, int32_t nbufs, int64_t n,
-                        int32_t op, int64_t* out) {
-  ordered_reduce<int64_t, combine_int<int64_t>>(bufs, nbufs, n, op, out);
+int32_t ordered_reduce_i64(const int64_t* const* bufs, int32_t nbufs,
+                           int64_t n, int32_t op, int64_t* out) {
+  return ordered_reduce_integer<int64_t, combine_int<int64_t>>(bufs, nbufs,
+                                                               n, op, out);
 }
 
 }  // extern "C"

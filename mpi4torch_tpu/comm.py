@@ -94,6 +94,38 @@ def _named_op(method):
     return wrapped
 
 
+def _resolve_compression(compression):
+    """Resolve a facade ``compression=`` argument to a codec (or None).
+
+    ``None`` defers to the scope/process default
+    (config.default_compression / config.compression_scope); ``False`` or
+    ``"none"`` force the exact path even inside a compression scope."""
+    if compression is None:
+        from . import config as _cfg
+        compression = _cfg.default_compression()
+    from .compress import get_codec
+    return get_codec(compression)
+
+
+def _codec_for(tensor, codec, explicit):
+    """Float tensors only: quantization of integer/bool payloads (counts,
+    masks, descriptors) would silently truncate.  A scope-level default
+    degrades those to the exact path (enabling gradient compression must
+    not corrupt unrelated integer collectives); an EXPLICIT per-call
+    ``compression=`` on a non-float tensor is a misuse and raises, like
+    the facade's other explicit-argument checks."""
+    if codec is None:
+        return None
+    if not jnp.issubdtype(jnp.result_type(tensor), jnp.floating):
+        if explicit:
+            raise ValueError(
+                f"compression={codec.name!r} requires a floating tensor; "
+                f"got dtype {jnp.result_type(tensor)} (integer/bool "
+                "payloads would be truncated, not approximated)")
+        return None
+    return codec
+
+
 class MPI_Communicator:
     """Communicator wrapper (reference: src/__init__.py:89-240).
 
@@ -161,12 +193,32 @@ class MPI_Communicator:
 
     # ----------------------------------------------------------- collectives
 
-    @_named_op
-    def Allreduce(self, tensor, op: int):
+    def Allreduce(self, tensor, op: int, compression=None):
         """Element-wise combine across all ranks, result on every rank
         (reference: src/__init__.py:125-152, csrc/extension.cpp:274-308).
-        Only ``MPI_SUM`` is differentiable; other ops raise in backward."""
-        return self._backend().allreduce(tensor, op)
+        Only ``MPI_SUM`` is differentiable; other ops raise in backward.
+
+        ``compression`` selects a wire codec (:mod:`mpi4torch_tpu.compress`:
+        ``"q8"``, ``"q8_ef"``, ``"bf16"``, ``"bf16r"``, a Codec object, or
+        ``False`` to override an active ``compression_scope``).  Compressed
+        Allreduce is MPI_SUM-only and stays AD-transparent: its backward is
+        itself a compressed Allreduce.  The named scope gains the codec
+        suffix (``mpi4torch.Allreduce.q8``) so profiler traces distinguish
+        compressed transfers."""
+        codec = _codec_for(tensor, _resolve_compression(compression),
+                           explicit=compression is not None)
+        if codec is not None and op != C.MPI_SUM and compression is None:
+            # Scope/process defaults degrade non-sum reductions to the
+            # exact path (same rule as non-float dtypes): a MAX/bitwise
+            # Allreduce inside a gradient-compression scope never asked
+            # for compression.  An explicit compression= still raises in
+            # the backend.
+            codec = None
+        scope = "mpi4torch.Allreduce" + (f".{codec.name}" if codec else "")
+        with jax.named_scope(scope):
+            if codec is None:
+                return self._backend().allreduce(tensor, op)
+            return self._backend().allreduce_compressed(tensor, op, codec)
 
     @_named_op
     def Bcast_(self, tensor, root: int):
@@ -198,17 +250,42 @@ class MPI_Communicator:
             return packed_gather(self, tensor, gatheraxis, numelem, root)
         return self._backend().gather(tensor, gatheraxis, root)
 
-    @_named_op
-    def Allgather(self, tensor, gatheraxis: int, numelem=None):
+    def Allgather(self, tensor, gatheraxis: int, numelem=None,
+                  compression=None):
         """Gather with the result on every rank (reference:
         src/__init__.py:215-216, csrc/extension.cpp:633-734).  Per-rank
-        tuple ``numelem``: see :meth:`Gather`."""
+        tuple ``numelem``: see :meth:`Gather`.
+
+        ``compression`` selects a wire codec (see :meth:`Allreduce`); the
+        shard travels encoded and the adjoint is a compressed
+        reduce-scatter.  Not combinable with the packed (``numelem``)
+        path."""
         if numelem is not None:
-            from .ops.packed import packed_allgather
-            if isinstance(numelem, (int, _np.integer)):
-                numelem = (int(numelem),) * self.size   # uniform prefix
-            return packed_allgather(self, tensor, gatheraxis, numelem)
-        return self._backend().allgather(tensor, gatheraxis)
+            # Packed path: always exact — its padding/slicing contract
+            # assumes untouched values, so it opts out of scope defaults
+            # and rejects an explicit request; the span must NOT carry a
+            # codec suffix (no compressed transfer happens here).  The
+            # guard tests the RESOLVED codec so the no-compression
+            # spellings (False/"none"/"off") stay accepted.
+            if compression is not None:
+                from .compress import get_codec
+                if get_codec(compression) is not None:
+                    raise ValueError(
+                        "Allgather: compression= is not supported together "
+                        "with the packed numelem= path")
+            with jax.named_scope("mpi4torch.Allgather"):
+                from .ops.packed import packed_allgather
+                if isinstance(numelem, (int, _np.integer)):
+                    numelem = (int(numelem),) * self.size   # uniform prefix
+                return packed_allgather(self, tensor, gatheraxis, numelem)
+        codec = _codec_for(tensor, _resolve_compression(compression),
+                           explicit=compression is not None)
+        scope = "mpi4torch.Allgather" + (f".{codec.name}" if codec else "")
+        with jax.named_scope(scope):
+            if codec is None:
+                return self._backend().allgather(tensor, gatheraxis)
+            return self._backend().allgather_compressed(tensor, gatheraxis,
+                                                        codec)
 
     @_named_op
     def Reduce_scatter(self, tensor, op: int, scatteraxis: int):
@@ -310,6 +387,14 @@ class _EagerBackend:
 
     def allreduce(self, x, op):
         return _eager.allreduce(self._ctx, x, op)
+
+    def allreduce_compressed(self, x, op, codec):
+        from .compress import eager as _ceager
+        return _ceager.allreduce(self._ctx, x, op, codec)
+
+    def allgather_compressed(self, x, gatheraxis, codec):
+        from .compress import eager as _ceager
+        return _ceager.allgather(self._ctx, x, gatheraxis, codec)
 
     def bcast_(self, x, root):
         return _eager.bcast_(self._ctx, x, root)

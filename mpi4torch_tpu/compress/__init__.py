@@ -1,0 +1,57 @@
+"""Compressed collectives: AD-transparent block-scaled quantized wire.
+
+The dominant cost of collectives at scale is bytes over ICI/DCN; this
+package cuts them with wire-compression codecs while preserving the
+framework's core invariant — the backward pass of every compressed
+collective is itself a compressed collective (the paper's
+adjoint-is-a-collective property, on a quantized wire).  Design
+references: EQuARX (arxiv 2506.17615, block-scaled quantized AllReduce
+native to XLA) and "The Big Send-off" (arxiv 2504.18658, per-topology
+tunability — hence the codec registry, which later topology-aware
+autotuning plugs into).
+
+Usage — pick a codec per call, per scope, or process-wide::
+
+    y = comm.Allreduce(g, mpi.MPI_SUM, compression="q8")
+
+    with mpi.config.compression_scope("q8_ef"):
+        y = comm.Allreduce(g, mpi.MPI_SUM)          # scope default
+
+    mpi.config.set_default_compression("bf16")      # process default
+
+Both backends honor the same argument: under ``run_spmd``/``shard_map``
+(Mode A) the op lowers to the quantized ring reduce-scatter + encoded
+all-gather pipeline (compress/spmd.py, int8-width transfers visible in
+the lowered HLO and in profiler traces as ``mpi4torch.Allreduce.q8``
+spans); under ``run_ranks`` (Mode B) the codec runs at the rendezvous
+(compress/eager.py), so parity tests cover the same codec code path.
+
+Modules: :mod:`.codecs` (registry + q8/bf16/bf16r/q8_ef),
+:mod:`.spmd` (Mode A pipeline), :mod:`.eager` (Mode B rendezvous codec),
+:mod:`.ef` (cross-step error-feedback state for training loops).
+"""
+
+from __future__ import annotations
+
+from ..config import (compression_scope, default_compression,
+                      set_default_compression)
+from .codecs import (BF16Codec, BF16StochasticCodec, BlockQ8Codec, Codec,
+                     ErrorFeedbackCodec, available_codecs, get_codec,
+                     register_codec)
+from .ef import ef_allreduce, ef_init
+
+__all__ = [
+    "Codec",
+    "BlockQ8Codec",
+    "BF16Codec",
+    "BF16StochasticCodec",
+    "ErrorFeedbackCodec",
+    "available_codecs",
+    "get_codec",
+    "register_codec",
+    "compression_scope",
+    "default_compression",
+    "set_default_compression",
+    "ef_init",
+    "ef_allreduce",
+]

@@ -1,0 +1,190 @@
+"""Mode B: compressed collectives for the thread-SPMD eager runtime.
+
+The codec runs at the rendezvous: each rank encodes its tensor and ships
+the *encoded* payload (plus its static meta) through ``World.exchange``,
+and every rank decodes the full payload list and folds in ascending rank
+order — so the semantics/parity path covers the same codec code as the
+SPMD pipeline, results are bit-identical across ranks (everyone decodes
+the same list with the same deterministic fold), and the misuse
+detectors (signature checks, consumed-input guard, tracing rejection)
+apply to compressed ops exactly as to exact ones.
+
+Large payloads take the fold-once path the exact Allreduce uses
+(ops/eager.py ``_FOLD_ONCE_MIN``): rank 0 decodes and folds once and a
+second rendezvous shares the (immutable jnp) result, instead of W ranks
+each decoding and folding W payloads redundantly.
+
+Stochastic codecs (``bf16r``) fold a per-(world, rank) call counter into
+their PRNG key, so repeated collectives round with fresh noise — the
+unbiased-accumulation property holds across optimizer steps here (the
+traced Mode A pipeline documents its weaker key schedule in
+compress/spmd.py).
+
+AD transparency matches compress/spmd.py: each op is a
+``jax.custom_vjp`` whose backward is itself a compressed collective, and
+the backward honors the codec's error-feedback rounds like the forward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants as C
+from ..runtime import CommError, RankContext
+from ..ops.eager import _FOLD_ONCE_MIN, _check_concrete, _norm_axis, \
+    _shape_sig
+from .codecs import Codec
+
+
+def _rank_key(codec: Codec, ctx: RankContext, salt: int):
+    if not getattr(codec, "stochastic", False):
+        return None
+    # Per-(world, rank) monotonic call counter: each rank touches only its
+    # own slot, so the dict needs no lock beyond the GIL's atomic ops.
+    seq = ctx.world.__dict__.setdefault("_compress_call_seq", {})
+    n = seq.get(ctx.rank, 0)
+    seq[ctx.rank] = n + 1
+    key = jax.random.fold_in(jax.random.PRNGKey(0), salt)
+    key = jax.random.fold_in(key, ctx.rank)
+    return jax.random.fold_in(key, n)
+
+
+def allreduce(ctx: RankContext, x, op: int, codec: Codec):
+    """Compressed eager Allreduce: encoded payloads meet at the
+    rendezvous; the decoded contributions fold in ascending rank order
+    (once, shared, above the fold-once threshold).  Sum-only, like the
+    SPMD path; the adjoint is the same compressed collective on the
+    cotangents."""
+    if op != C.MPI_SUM:
+        raise CommError(
+            f"compressed Allreduce supports MPI_SUM only; got "
+            f"{C.op_name(op)} — drop compression= for non-sum reductions")
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(rank, x)
+    base = codec.base()
+
+    def one_round(v, salt: int):
+        """Returns (cross-rank sum of decoded payloads, own roundtrip)."""
+        payload, meta = base.encode(v, _rank_key(base, ctx, salt))
+        sig = ("Allreduce.c", codec.name, salt, _shape_sig(v))
+        vals = world.exchange(rank, sig, (meta, payload))
+        if jnp.asarray(v).size >= _FOLD_ONCE_MIN:
+            # Fold-once: rank 0 decodes + folds all payloads, the result
+            # (an immutable jnp array) is shared through a second
+            # rendezvous; every other rank decodes only its own payload
+            # (needed for the EF residual) — W-1 redundant W-way
+            # decode+folds saved, mirroring ops/eager.py's exact path.
+            own_m, own_p = vals[rank]
+            own = base.decode(own_p, own_m)
+            red = (C.reduce_ordered(
+                C.MPI_SUM, [base.decode(p, m) for (m, p) in vals])
+                if rank == 0 else None)
+            out = world.exchange(
+                rank, ("Allreduce.c.fold", codec.name, salt, _shape_sig(v)),
+                red)[0]
+            return out, own
+        decoded = [base.decode(p, m) for (m, p) in vals]
+        return C.reduce_ordered(C.MPI_SUM, decoded), decoded[rank]
+
+    def impl(v):
+        _check_concrete(v)
+        if world.size == 1:
+            return jnp.asarray(v)
+        out, own = one_round(v, 0)
+        for round_idx in range(1, codec.ef_rounds):
+            # In-call error feedback: sum the compressed local residuals
+            # (``own`` IS this rank's roundtrip, so the residual costs no
+            # extra encode).
+            resid = jnp.asarray(v) - own
+            more, own_r = one_round(resid, round_idx)
+            out = out + more
+            own = own + own_r
+        return out
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    def bwd(_, g):
+        return (impl(g),)
+
+    f.defvjp(lambda v: (impl(v), None), bwd)
+    return f(x)
+
+
+def allgather(ctx: RankContext, x, gatheraxis: int, codec: Codec):
+    """Compressed eager Allgather along an arbitrary axis; per-rank axis
+    lengths may differ (each payload carries its own meta, like the exact
+    op ships concrete arrays).  Adjoint: compressed reduce-scatter —
+    every rank's cotangent ships encoded and each rank folds its own
+    segment of the decoded gradients in ascending rank order, with the
+    codec's error-feedback rounds honored like the forward."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(rank, x)
+    ax = _norm_axis(gatheraxis, jnp.ndim(x))
+    base = codec.base()
+
+    def gather_round(v, salt: int):
+        payload, meta = base.encode(v, _rank_key(base, ctx, salt))
+        othershape = tuple(s for i, s in enumerate(v.shape) if i != ax)
+        sig = ("Allgather.c", codec.name, salt, ax, othershape,
+               str(jnp.asarray(v).dtype))
+        vals = world.exchange(rank, sig, (meta, payload))
+        decoded = [base.decode(p, m) for (m, p) in vals]
+        return decoded
+
+    def impl(v):
+        _check_concrete(v)
+        if world.size == 1:
+            return jnp.asarray(v)
+        decoded = gather_round(v, 0)
+        out = jnp.concatenate(decoded, axis=ax)
+        counts = tuple(d.shape[ax] for d in decoded)
+        for round_idx in range(1, codec.ef_rounds):
+            resid = jnp.asarray(v) - decoded[rank]
+            decoded2 = gather_round(resid, round_idx)
+            out = out + jnp.concatenate(decoded2, axis=ax)
+            decoded = [d + d2 for d, d2 in zip(decoded, decoded2)]
+        return out, counts
+
+    def bwd_round(g, counts, salt: int):
+        payload, meta = base.encode(g, _rank_key(base, ctx, salt))
+        sig = ("Allgather.c.bwd", codec.name, salt, ax, _shape_sig(g))
+        vals = world.exchange(rank, sig, (meta, payload))
+        offset = sum(counts[:rank])
+        index = [slice(None)] * jnp.ndim(g)
+        index[ax] = slice(offset, offset + counts[rank])
+        pieces = [base.decode(p, m)[tuple(index)] for (m, p) in vals]
+        own_m, own_p = vals[rank]
+        own_full = base.decode(own_p, own_m)
+        return C.reduce_ordered(C.MPI_SUM, pieces), own_full
+
+    def bwd_impl(counts, g):
+        _check_concrete(g)
+        seg, own = bwd_round(g, counts, 100)
+        for round_idx in range(1, codec.ef_rounds):
+            resid = jnp.asarray(g) - own
+            more, own_r = bwd_round(resid, counts, 100 + round_idx)
+            seg = seg + more
+            own = own + own_r
+        return seg
+
+    @jax.custom_vjp
+    def f(v):
+        out = impl(v)
+        return out if world.size == 1 else out[0]
+
+    def fwd(v):
+        out = impl(v)
+        if world.size == 1:
+            return out, (tuple(jnp.shape(v))[ax] if jnp.ndim(v) else 1,)
+        return out[0], out[1]
+
+    def bwd(counts, g):
+        if world.size == 1:
+            return (g,)
+        return (bwd_impl(counts, g),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)

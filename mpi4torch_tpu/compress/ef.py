@@ -1,0 +1,84 @@
+"""Cross-step error feedback for compressed gradient AllReduce.
+
+``compression="q8_ef"`` compensates quantization error *within* one
+collective (a second residual round, 2x the q8 wire).  This module is the
+cheaper alternative for iterative training: carry the residual *across*
+optimizer steps (EF-SGD / EF21 style, Karimireddy et al. 2019) so each
+step pays single-round q8 wire while the un-transmitted error is added
+back into the next step's gradient — over a run, nothing is lost to
+quantization except a one-step delay.
+
+The state is a plain pytree (functional, jit/scan-friendly)::
+
+    resid = ef_init(grads)                       # zeros like grads
+    for step in range(n_steps):
+        grads = grad_fn(params)
+        synced, resid = ef_allreduce(comm, grads, resid,
+                                     compression="q8")
+        params = update(params, synced)
+
+Works on both backends: the collective inside is the facade
+``Allreduce(..., compression=...)``, so Mode A runs it as the quantized
+ring pipeline and Mode B at the rendezvous.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants as C
+from .codecs import get_codec
+
+__all__ = ["ef_init", "ef_allreduce"]
+
+
+def ef_init(tree):
+    """Zero residual state shaped like ``tree`` (one leaf per gradient
+    leaf, same dtype — the residual lives in the gradient's own
+    precision)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def ef_allreduce(comm, tree, residual, op: int = C.MPI_SUM,
+                 compression="q8"):
+    """Error-compensated compressed AllReduce over a gradient pytree.
+
+    Each leaf is corrected by its carried residual, summed across ranks
+    through ``comm.Allreduce(..., compression=...)``, and the new
+    residual (what this rank's codec failed to transmit this step) is
+    returned for the next call.  Returns ``(synced_tree, new_residual)``.
+    """
+    codec = get_codec(compression)
+    if codec is None:
+        synced = jax.tree_util.tree_map(
+            lambda g: comm.Allreduce(g, op, compression=False), tree)
+        return synced, residual
+
+    # The carried residual must be computed against what the wire actually
+    # transmitted.  Cross-step EF *replaces* in-call EF, so a multi-round
+    # codec (q8_ef) is reduced to its single-round base here: otherwise
+    # the collective would transmit ~all of `corrected` (second-order
+    # error) while the carried residual still recorded the full
+    # first-order error — re-injecting already-transmitted gradient every
+    # step.
+    base = codec.base()
+    leaves_g, treedef = jax.tree_util.tree_flatten(tree)
+    leaves_r = treedef.flatten_up_to(residual)
+    synced_leaves, resid_leaves = [], []
+    for g, r in zip(leaves_g, leaves_r):
+        corrected = g + r.astype(g.dtype)
+        synced_leaves.append(comm.Allreduce(corrected, op,
+                                            compression=base))
+        if getattr(base, "stochastic", False):
+            # A stochastic codec's wire keys (per rank/hop inside the
+            # collective) cannot be reproduced locally, so a residual
+            # computed here would be uncorrelated noise, not the
+            # transmission error.  Unbiased rounding needs no error
+            # feedback anyway (E[decode(encode(x))] = x): carry zero.
+            new_r = jnp.zeros_like(corrected)
+        else:
+            new_r = corrected - base.roundtrip(corrected)
+        resid_leaves.append(new_r.astype(r.dtype))
+    return (jax.tree_util.tree_unflatten(treedef, synced_leaves),
+            jax.tree_util.tree_unflatten(treedef, resid_leaves))

@@ -1,0 +1,289 @@
+"""Mode A: compressed collectives over a shard_map mesh axis.
+
+The AllReduce pipeline is the EQuARX shape (arxiv 2506.17615): quantize →
+ring reduce-scatter in low precision → dequantize → all-gather of the
+encoded shards.  Each ring hop ships the *encoded* partial sum (int8
+payload + per-block scales for ``q8``; bf16 words for the bf16 family)
+through ``lax.ppermute`` and re-quantizes after accumulating in f32, so
+bytes-on-wire drop by the codec ratio on every link; the final all-gather
+also travels encoded, and every rank decodes the same gathered payload —
+making the result bit-identical across ranks by construction (the same
+invariant the exact ``_ring_fold_*`` machinery in ops/spmd.py provides).
+
+AD transparency is preserved the same way as the exact ops: each public
+op is a ``jax.custom_vjp`` whose backward is *itself a compressed
+collective* — the adjoint of a compressed sum-AllReduce is a compressed
+sum-AllReduce of the cotangents, the adjoint of a compressed Allgather is
+a compressed reduce-scatter (the paper's adjoint-is-a-collective
+invariant, SURVEY.md §2.2, carried over to the quantized wire).
+
+Ring schedule (chunk ``c`` is delivered, fully reduced, to rank ``c``):
+at step ``s`` rank ``r`` sends the partial of chunk ``(r - 1 - s) mod n``
+and receives the partial of chunk ``(r - 2 - s) mod n``, adding its own
+contribution — ``n - 1`` hops, unrolled statically (axis sizes on a TPU
+slice axis are O(tens); a ``lax.scan`` form like ops/spmd.py's
+``_ring_fold_*`` is the scaling follow-up when slices grow).
+
+Stochastic codecs (``bf16r``) get a per-rank, per-hop PRNG key (base key
+folded with ``lax.axis_index``, the hop counter, and a fingerprint of the
+encoded values) so rounding noise is independent across contributions;
+correlated noise would bias the sum.  See :func:`_hop_key` for the
+traced-program limitation on identical repeated inputs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import constants as C
+from ..runtime import CommError
+from .codecs import Codec
+
+
+def _hop_key(codec: Codec, axis_name: str, salt: int,
+             data=None) -> Optional[jax.Array]:
+    """Per-rank, per-hop PRNG key for stochastic codecs; when ``data`` is
+    given, a value fingerprint (bitcast of its f32 sum) is folded in so
+    different payloads round with different noise.  Limitation, by
+    construction: a traced program has no step counter, so re-executing
+    the SAME compiled collective on the IDENTICAL tensor reuses the same
+    rounding noise — exact-constant accumulation degenerates to
+    deterministic rounding on this backend (the eager backend advances a
+    real per-call counter; see compress/eager.py)."""
+    if not getattr(codec, "stochastic", False):
+        return None
+    key = jax.random.fold_in(jax.random.PRNGKey(0), salt)
+    key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    if data is not None:
+        fp = lax.bitcast_convert_type(
+            jnp.sum(jnp.asarray(data, jnp.float32)), jnp.uint32)
+        key = jax.random.fold_in(key, fp)
+    return key
+
+
+def _tree_ppermute(payload, axis_name: str, ring):
+    return jax.tree_util.tree_map(
+        lambda a: lax.ppermute(a, axis_name, perm=ring), payload)
+
+
+def _tree_all_gather(payload, axis_name: str):
+    return jax.tree_util.tree_map(
+        lambda a: lax.all_gather(a, axis_name, axis=0, tiled=False), payload)
+
+
+def _tree_index(payload, r: int):
+    return jax.tree_util.tree_map(lambda a: a[r], payload)
+
+
+def _ring_reduce_scatter_chunks(ctx, xc, codec: Codec, salt: int,
+                                track_err: bool = False):
+    """Quantized ring reduce-scatter over pre-chunked data.
+
+    ``xc``: (n, m) f32 — row ``c`` is this rank's contribution to chunk
+    ``c``.  Returns ``(part, err)``: the (m,) f32 fully-reduced chunk
+    owned by this rank (chunk ``r`` lands on rank ``r``) and, when
+    ``track_err``, an (n, m) buffer holding THIS rank's quantization
+    residual per hop, stored at the row of the chunk it encoded (the hops
+    encode pairwise-distinct chunks, so rows never collide).  Every hop
+    encodes the running partial, permutes the payload one step along the
+    ring, decodes, and accumulates in f32 — low precision on the wire,
+    full precision in the accumulator."""
+    n = ctx.size
+    axis = ctx.axis_name
+    idx = lax.axis_index(axis)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    err = jnp.zeros_like(xc) if track_err else None
+    part = lax.dynamic_index_in_dim(xc, (idx - 1) % n, 0, keepdims=False)
+    for s in range(n - 1):
+        payload, meta = codec.encode(part, _hop_key(codec, axis,
+                                                    salt * 1000 + s,
+                                                    data=part))
+        if track_err:
+            err = lax.dynamic_update_index_in_dim(
+                err, part - codec.decode(payload, meta),
+                (idx - 1 - s) % n, axis=0)
+        recv = _tree_ppermute(payload, axis, ring)
+        c = (idx - 2 - s) % n
+        mine = lax.dynamic_index_in_dim(xc, c, 0, keepdims=False)
+        part = mine + codec.decode(recv, meta)
+    return part, err
+
+
+def _allreduce_round(ctx, x, codec: Codec, salt: int,
+                     track_err: bool = False):
+    """One compressed sum-AllReduce round: chunk → quantized ring
+    reduce-scatter → encoded all-gather → decode & reassemble.
+
+    With ``track_err``, also returns this rank's total quantization
+    residual as a tensor of ``x``'s shape: every encode the rank
+    performed (ring hops + the final gather encode) contributes
+    ``value - decode(encode(value))`` at the chunk it encoded.  Summing
+    the per-rank residuals over ranks reproduces the round's entire
+    first-order error — that sum is exactly what the error-feedback
+    round transfers."""
+    n = ctx.size
+    shape, dtype = x.shape, x.dtype
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    total = flat.size
+    seg = -(-max(total, 1) // n)
+    pad = seg * n - total
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    xc = flat.reshape(n, seg)
+
+    part, err = _ring_reduce_scatter_chunks(ctx, xc, codec, salt,
+                                            track_err=track_err)
+
+    payload, meta = codec.encode(part, _hop_key(codec, ctx.axis_name,
+                                                salt * 1000 + n,
+                                                data=part))
+    gathered = _tree_all_gather(payload, ctx.axis_name)
+    pieces = [codec.decode(_tree_index(gathered, r), meta) for r in range(n)]
+    out = jnp.concatenate(pieces)[:total]
+    out = out.reshape(shape).astype(dtype)
+    if not track_err:
+        return out
+    idx = lax.axis_index(ctx.axis_name)
+    err = lax.dynamic_update_index_in_dim(
+        err, lax.dynamic_index_in_dim(err, idx, 0, keepdims=False)
+        + (part - codec.decode(payload, meta)), idx, axis=0)
+    resid = err.reshape(-1)[:total].reshape(shape).astype(dtype)
+    return out, resid
+
+
+def _allreduce_value(ctx, x, codec: Codec):
+    if ctx.size == 1:
+        return x
+    base = codec.base()
+    if codec.ef_rounds <= 1:
+        return _allreduce_round(ctx, x, base, salt=0)
+    # In-call error feedback: round 1 tracks every quantization residual
+    # this rank produced (ring hops + final gather encode); their
+    # cross-rank sum IS the round's first-order error, so transferring
+    # the residuals through a second compressed round cancels it
+    # (EF-SGD, Karimireddy et al. 2019, folded into the collective so
+    # ``compression="q8_ef"`` needs no carried state).  Remaining error
+    # is second-order: the residual round's own quantization of
+    # already-small values.
+    y, resid = _allreduce_round(ctx, x, base, salt=0, track_err=True)
+    for round_idx in range(1, codec.ef_rounds - 1):
+        more, resid = _allreduce_round(ctx, resid, base, salt=round_idx,
+                                       track_err=True)
+        y = y + more
+    return y + _allreduce_round(ctx, resid, base,
+                                salt=codec.ef_rounds - 1)
+
+
+def _reduce_scatter_value(ctx, g, ax: int, codec: Codec):
+    """Compressed sum-reduce-scatter along ``ax`` (equal segments): the
+    adjoint of the compressed Allgather.  Delivers segment ``r`` of the
+    cross-rank sum to rank ``r`` via the quantized ring — no full-tensor
+    broadcast.  Error-feedback rounds are honored like the forward: the
+    tracked hop residuals ride a further quantized ring, so a ``q8_ef``
+    Allgather's gradients are as tight as its values (no silent
+    downgrade of the backward to the single-round base)."""
+    n = ctx.size
+    if n == 1:
+        return g
+    if g.shape[ax] % n != 0:
+        raise CommError(
+            f"compressed reduce-scatter axis {ax} length {g.shape[ax]} "
+            f"must be divisible by the communicator size {n}")
+    base = codec.base()
+    m = g.shape[ax] // n
+    gm = jnp.moveaxis(g, ax, 0)
+    rest = gm.shape[1:]
+    xc = jnp.asarray(gm, jnp.float32).reshape(n, m * math.prod(rest))
+    track = codec.ef_rounds > 1
+    part, err = _ring_reduce_scatter_chunks(ctx, xc, base, salt=7,
+                                            track_err=track)
+    for round_idx in range(1, codec.ef_rounds):
+        # ``err`` holds this rank's per-hop residuals at the rows of the
+        # chunks it encoded; rechunking it row-for-row feeds the same
+        # segment partition, so the residual ring delivers each rank the
+        # correction for ITS segment.  (The delivered chunk itself is
+        # never re-encoded, so no final-encode residual exists here.)
+        last = round_idx == codec.ef_rounds - 1
+        more, err = _ring_reduce_scatter_chunks(ctx, err, base,
+                                                salt=7 + round_idx,
+                                                track_err=not last)
+        part = part + more
+    seg = part.reshape((m,) + rest).astype(g.dtype)
+    return jnp.moveaxis(seg, 0, ax)
+
+
+def _allgather_round(ctx, x, ax: int, codec: Codec, salt: int):
+    n = ctx.size
+    payload, meta = codec.encode(x, _hop_key(codec, ctx.axis_name, salt,
+                                             data=x))
+    gathered = _tree_all_gather(payload, ctx.axis_name)
+    pieces = [codec.decode(_tree_index(gathered, r), meta) for r in range(n)]
+    return jnp.concatenate(pieces, axis=ax)
+
+
+def _allgather_value(ctx, x, ax: int, codec: Codec):
+    if ctx.size == 1:
+        return x
+    base = codec.base()
+    out = _allgather_round(ctx, x, ax, base, salt=11)
+    for round_idx in range(1, codec.ef_rounds):
+        key = _hop_key(base, ctx.axis_name, -100 - round_idx)
+        resid = jnp.asarray(x, jnp.float32) \
+            - jnp.asarray(base.roundtrip(x, key), jnp.float32)
+        resid = resid.astype(x.dtype)
+        out = out + _allgather_round(ctx, resid, ax, base,
+                                     salt=11 + round_idx)
+    return out
+
+
+def _bwd_scope(opname: str, codec: Codec):
+    return jax.named_scope(f"mpi4torch.{opname}Backward.{codec.name}")
+
+
+def allreduce(ctx, x, op: int, codec: Codec):
+    """Compressed SPMD Allreduce.  Sum-only (quantized partial-sum
+    accumulation has no meaning for MAX/bitwise ops — use the exact
+    path); the adjoint is the same compressed collective applied to the
+    cotangents, so gradients ride the int8/bf16 wire too."""
+    if op != C.MPI_SUM:
+        raise CommError(
+            f"compressed Allreduce supports MPI_SUM only; got "
+            f"{C.op_name(op)} — drop compression= for non-sum reductions")
+
+    @jax.custom_vjp
+    def f(v):
+        return _allreduce_value(ctx, v, codec)
+
+    def bwd(_, g):
+        with _bwd_scope("Allreduce", codec):
+            return (_allreduce_value(ctx, g, codec),)
+
+    f.defvjp(lambda v: (_allreduce_value(ctx, v, codec), None), bwd)
+    return f(x)
+
+
+def allgather(ctx, x, gatheraxis: int, codec: Codec):
+    """Compressed SPMD Allgather: the local shard travels encoded through
+    one ``lax.all_gather``; every rank decodes the same payload (results
+    bit-identical across ranks).  Adjoint: compressed reduce-scatter of
+    the cotangents — itself a collective on the quantized wire."""
+    from ..ops.eager import _norm_axis
+
+    ax = _norm_axis(gatheraxis, jnp.ndim(x))
+
+    @jax.custom_vjp
+    def f(v):
+        return _allgather_value(ctx, v, ax, codec)
+
+    def bwd(_, g):
+        with _bwd_scope("Allgather", codec):
+            return (_reduce_scatter_value(ctx, g, ax, codec),)
+
+    f.defvjp(lambda v: (_allgather_value(ctx, v, ax, codec), None), bwd)
+    return f(x)

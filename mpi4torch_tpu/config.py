@@ -1,7 +1,7 @@
 """Framework configuration flags.
 
 The reference has no config system (SURVEY.md §5: three compile-time toggles
-total).  This framework adds exactly one semantic knob:
+total).  This framework adds two semantic knobs:
 
 ``deterministic_reductions`` — when True, SPMD-mode SUM reductions are
 computed as an all-gather followed by a fixed ascending-rank-order fold,
@@ -9,6 +9,15 @@ which is bit-identical to the eager thread-SPMD oracle (the 'MPI linear
 order' reference) at the cost of bandwidth; when False (default), they lower
 to ``lax.psum`` — the XLA/ICI-native reduction, fastest but with
 compiler-chosen combining order (ulp-level differences possible).
+
+``default_compression`` — the wire-compression codec applied by default to
+``Allreduce``/``Allgather`` calls that do not pass an explicit
+``compression=`` argument (mpi4torch_tpu.compress; None = exact fp wire).
+Set it process-wide with :func:`set_default_compression` or lexically with
+the :func:`compression_scope` context manager.  Like the deterministic
+flag, the value is read at *trace* time: ``run_spmd`` makes it part of the
+jit cache key so toggling retraces, but a user-managed ``jax.jit`` that
+already traced keeps its lowering until it retraces.
 """
 
 from __future__ import annotations
@@ -35,3 +44,61 @@ def deterministic_mode(value: bool = True):
         yield
     finally:
         set_deterministic_reductions(prev)
+
+
+# Sentinel distinguishing "no scope active on this thread" from an explicit
+# compression_scope(None) (which forces exact transfers within the block).
+_UNSET = object()
+_process_default = None
+
+
+def default_compression():
+    """The codec (object or registered name) facade ops use when
+    ``compression=None`` is passed: the innermost active
+    :func:`compression_scope` on this thread, else the process-wide
+    :func:`set_default_compression` value (None = no compression)."""
+    scoped = getattr(_state, "compression", _UNSET)
+    return _process_default if scoped is _UNSET else scoped
+
+
+def _validated(codec):
+    if codec is None:
+        return None
+    from .compress import get_codec
+
+    return get_codec(codec)  # resolve names; ad-hoc codec objects pass
+
+
+def set_default_compression(codec) -> None:
+    """Set the process-wide default wire-compression codec (a registered
+    name, a Codec object, or None to disable).  Visible on every thread —
+    including ``run_ranks`` rank-threads — unless a thread's own
+    :func:`compression_scope` overrides it."""
+    global _process_default
+    _process_default = _validated(codec)
+
+
+@contextmanager
+def compression_scope(codec):
+    """Lexically scoped compression default::
+
+        with mpi.config.compression_scope("q8"):
+            y = comm.Allreduce(g, mpi.MPI_SUM)   # rides the wire as int8
+
+    ``compression_scope(None)`` forces exact transfers for the block even
+    when a process default is set.  The scope itself is per-thread (like
+    ``deterministic_mode``): a scope opened before ``run_ranks`` is not
+    seen by the rank-threads — use :func:`set_default_compression`, open
+    the scope inside the rank body, or pass ``compression=`` explicitly
+    for collective agreement there.  ``run_spmd`` re-reads the value at
+    call time and makes it part of its jit cache key, so toggling
+    retraces."""
+    prev = getattr(_state, "compression", _UNSET)
+    _state.compression = _validated(codec)
+    try:
+        yield
+    finally:
+        if prev is _UNSET:
+            del _state.compression
+        else:
+            _state.compression = prev

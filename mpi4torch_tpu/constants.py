@@ -59,6 +59,30 @@ def fold_supported(op: int) -> bool:
     return op in _OP_NAMES and op not in (MPI_MINLOC, MPI_MAXLOC)
 
 
+_BITWISE_OPS = (MPI_BAND, MPI_BOR, MPI_BXOR)
+
+
+def fold_applicable(op: int, dtype) -> bool:
+    """Dtype-aware :func:`fold_supported`: True iff combine2 can evaluate
+    ``op`` on operands of ``dtype`` without raising.
+
+    The fold-delegation gates (eager Allreduce fold-once, Reduce_'s
+    root-only fold) must key on this, not on :func:`fold_supported`
+    alone: an op that is supported in general but invalid for the dtype
+    (e.g. ``MPI_BAND`` on floats — bitwise ops are integer/bool-only,
+    like MPI's own op/dtype table, reference csrc/extension.cpp:106-129)
+    would otherwise raise only on the folding rank while the other ranks
+    skip ahead — a rank death plus broken-barrier aborts instead of the
+    symmetric informative error on every rank (ADVICE r5)."""
+    if not fold_supported(op):
+        return False
+    import numpy as _np
+
+    if op in _BITWISE_OPS:
+        return _np.dtype(dtype).kind in "iub"
+    return True
+
+
 def combine2(op: int, a, b):
     """Elementwise combination of two operands for reduction op ``op``.
 

@@ -205,7 +205,10 @@ def dp_grad_train_step(comm, cfg: ResNetConfig, params, state, batch,
     grads = jax.tree.map(lambda g: comm.Allreduce(g, MPI_SUM) / size, grads)
     global_loss = comm.Allreduce(loss, MPI_SUM) / size
     new_state = jax.tree.map(
-        lambda s: comm.Allreduce(s, MPI_SUM) / size, new_state)
+        # compression=False: BN running stats are carried state — codec
+        # error would accumulate across steps.
+        lambda s: comm.Allreduce(s, MPI_SUM, compression=False) / size,
+        new_state)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return global_loss, new_params, new_state
 
@@ -226,6 +229,9 @@ def dp_loss_train_step(comm, cfg: ResNetConfig, params, state, batch,
     (loss, new_state), grads = jax.value_and_grad(
         global_loss_fn, has_aux=True)(params)
     new_state = jax.tree.map(
-        lambda s: comm.Allreduce(s, MPI_SUM) / size, new_state)
+        # compression=False: BN running stats are carried state — codec
+        # error would accumulate across steps.
+        lambda s: comm.Allreduce(s, MPI_SUM, compression=False) / size,
+        new_state)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return loss, new_params, new_state

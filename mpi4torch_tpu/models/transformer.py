@@ -683,7 +683,11 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
                                   axis=-1)[..., 0]
     local_sum = jnp.sum(ce * mask[None, :])
     if sp > 1:
-        total = comm_sp.Allreduce(local_sum, MPI_SUM)
+        # compression=False on internal sums: softmax denominators, aux
+        # stats and loss averages are numerical internals with exact-
+        # parity contracts — a user gradient-compression scope must
+        # not reach them.
+        total = comm_sp.Allreduce(local_sum, MPI_SUM, compression=False)
     else:
         total = local_sum
     loss = total / (b * (s_global - 1))
@@ -692,7 +696,7 @@ def lm_loss(cfg: TransformerConfig, params, tokens, comm_sp=None,
             # Each sp rank's aux reflects only its own sequence shard's
             # routing; average it so the loss stays rank-identical (the
             # lock-step invariant every collective loss must keep).
-            aux = comm_sp.Allreduce(aux, MPI_SUM) / sp
+            aux = comm_sp.Allreduce(aux, MPI_SUM, compression=False) / sp
         loss = loss + cfg.aux_coef * aux
     return loss
 
@@ -727,7 +731,7 @@ def zero_train_step(cfg: TransformerConfig, params, tokens, opt,
             p = all_average_tree(comm_ep, p)
         loss = lm_loss(cfg, p, tokens, comm_sp, attn, comm_ep=comm_ep)
         if comm_ep is not None and comm_ep.size > 1:
-            loss = comm_ep.Allreduce(loss, MPI_SUM) / comm_ep.size
+            loss = comm_ep.Allreduce(loss, MPI_SUM, compression=False) / comm_ep.size
         return loss
 
     loss, grads = jax.value_and_grad(local_loss)(params)
@@ -737,7 +741,7 @@ def zero_train_step(cfg: TransformerConfig, params, tokens, opt,
     new_params, new_state = zero_step(comm_dp, opt, params, grads,
                                       opt_state)
     # Report the dp-global mean loss.
-    loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+    loss = comm_dp.Allreduce(loss, MPI_SUM, compression=False) / comm_dp.size
     return loss, new_params, new_state
 
 
@@ -764,7 +768,7 @@ def zero3_train_step(cfg: TransformerConfig, p_shards, template, tokens,
 
     loss, new_shards, new_state = zero3_step(
         comm_dp, opt, p_shards, template, local_loss, opt_state)
-    loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+    loss = comm_dp.Allreduce(loss, MPI_SUM, compression=False) / comm_dp.size
     return loss, new_shards, new_state
 
 
@@ -802,9 +806,9 @@ def train_step(cfg: TransformerConfig, params, tokens, comm_sp=None,
             p = all_average_tree(comm_ep, p)
         loss = lm_loss(cfg, p, tokens, comm_sp, attn, comm_ep=comm_ep)
         if comm_dp is not None and comm_dp.size > 1:
-            loss = comm_dp.Allreduce(loss, MPI_SUM) / comm_dp.size
+            loss = comm_dp.Allreduce(loss, MPI_SUM, compression=False) / comm_dp.size
         if comm_ep is not None and comm_ep.size > 1:
-            loss = comm_ep.Allreduce(loss, MPI_SUM) / comm_ep.size
+            loss = comm_ep.Allreduce(loss, MPI_SUM, compression=False) / comm_ep.size
         return loss
 
     loss, grads = jax.value_and_grad(global_loss)(params)

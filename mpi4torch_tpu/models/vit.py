@@ -151,7 +151,9 @@ def forward_patches(cfg: ViTConfig, params, patches, comm_sp=None,
     pooled = jnp.mean(x, axis=1)
     if sp:
         # Mean over the full patch axis = mean of equal-shard means.
-        pooled = comm_sp.Allreduce(pooled, MPI_SUM) / comm_sp.size
+        # compression=False: forward activations (sequence-parallel pool).
+        pooled = comm_sp.Allreduce(pooled, MPI_SUM,
+                                   compression=False) / comm_sp.size
     return pooled @ params["head"]
 
 

@@ -99,15 +99,31 @@ def allreduce(ctx: RankContext, x, op: int):
         _check_concrete(v)
         sig = _shape_sig(v)
         vals = world.exchange(rank, ("Allreduce", op, sig), v)
-        if jnp.asarray(v).size >= _FOLD_ONCE_MIN and C.fold_supported(op):
+        va = jnp.asarray(v)
+        if va.size >= _FOLD_ONCE_MIN and C.fold_applicable(op, va.dtype):
             # Every rank would compute the IDENTICAL ascending-rank fold;
             # above the threshold, rank 0 folds once and a second
             # rendezvous shares the (immutable) result — W-1 redundant
             # folds saved, and the fold runs single-caller, matching the
             # pattern _NATIVE_REDUCE_MIN_SIZE is calibrated for
             # (constants.py).  Below it, two extra barrier waits cost
-            # more than the duplicate tiny folds.
-            red = C.reduce_ordered(op, vals) if rank == 0 else None
+            # more than the duplicate tiny folds.  The gate is the
+            # dtype-aware predicate: a dtype-invalid op (MPI_BAND on
+            # floats) must stay on the every-rank path so it raises
+            # symmetrically (ADVICE r5, constants.fold_applicable).
+            if rank == 0:
+                red = C.reduce_ordered(op, vals)
+                if (isinstance(red, np.ndarray) and red.flags.writeable
+                        and not any(red is x for x in vals)):
+                    # The SAME object is handed to every rank thread; a
+                    # jnp result is immutable, but the numpy path (numpy
+                    # inputs keep numpy through the fold) is not — freeze
+                    # it so an in-place edit on one rank cannot silently
+                    # corrupt the others' results (in MPI these are
+                    # distinct buffers in distinct processes; ADVICE r5).
+                    red.flags.writeable = False
+            else:
+                red = None
             return world.exchange(rank, ("Allreduce.fold", op, sig), red)[0]
         return C.reduce_ordered(op, vals)
 
@@ -238,10 +254,12 @@ def reduce_(ctx: RankContext, x, op: int, root: int):
         _check_concrete(v)
         vals = world.exchange(rank, ("Reduce_", op, root, _shape_sig(v)), v)
         # Non-root ranks discard the reduction, so they only compute it
-        # when the fold itself would raise (unsupported op) — keeping the
+        # when the fold itself would raise (unsupported op, or an op the
+        # dtype rejects — e.g. MPI_BAND on floats) — keeping the
         # informative rejection symmetric across ranks while skipping
-        # W-1 redundant memory-bound folds otherwise.
-        if rank == root or not C.fold_supported(op):
+        # W-1 redundant memory-bound folds otherwise (ADVICE r5: the
+        # gate must be dtype-aware, not fold_supported alone).
+        if rank == root or not C.fold_applicable(op, jnp.asarray(v).dtype):
             red = C.reduce_ordered(op, vals)
             return red if rank == root else jnp.zeros_like(red)
         return jnp.zeros_like(v)

@@ -218,9 +218,9 @@ def _parallel_grid_params():
     fully independent (each step writes a distinct output block; all
     reduction lives in in-core fori_loops), so Mosaic may pipeline the
     grid and split it across cores on megacore parts."""
-    from jax.experimental.pallas import tpu as pltpu
+    from .._compat import tpu_compiler_params
 
-    return pltpu.CompilerParams(
+    return tpu_compiler_params(
         dimension_semantics=("parallel", "parallel"))
 
 

@@ -124,7 +124,9 @@ def packed_allgather(comm, x, gatheraxis: int, numelem):
             f"Allgather: numelem {counts} exceeds the padded axis length "
             f"{cap} (axis {gatheraxis})")
     xz = _mask_valid(x, ax, _my_count(comm, counts))
-    full = comm.Allgather(xz, ax)
+    # compression=False: the packed contract reassembles exact padded
+    # values; a scope-level codec must not quantize them.
+    full = comm.Allgather(xz, ax, compression=False)
     return jnp.take(full, jnp.asarray(_pack_index(counts, cap)), axis=ax)
 
 

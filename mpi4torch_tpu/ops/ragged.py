@@ -120,7 +120,9 @@ def ragged_allgather(comm, x, count) -> Tuple:
     result (see tests)."""
     capacity, count = _validated_scalar_count("ragged_allgather", x, count)
     xz = _masked(x, count, capacity)
-    gathered = comm.Allgather(xz[None], gatheraxis=0)
+    # compression=False: ragged reassembly slices exact padded values;
+    # a scope-level codec must not quantize them.
+    gathered = comm.Allgather(xz[None], gatheraxis=0, compression=False)
     counts = comm.Allgather(count[None], gatheraxis=0)
     return gathered, counts
 

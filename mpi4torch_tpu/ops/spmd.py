@@ -58,6 +58,7 @@ from jax import lax
 
 from .. import config as _config
 from .. import constants as C
+from .._compat import optimization_barrier as _opt_barrier
 from ..runtime import (
     BifurcationError,
     CommError,
@@ -938,7 +939,7 @@ def _fresh(x):
     """Pass through an optimization barrier to obtain a unique tracer
     object — the handle identity key (the analogue of the reference's
     buffer-pointer hash, csrc/extension.cpp:1100)."""
-    return lax.optimization_barrier(x)
+    return _opt_barrier(x)
 
 
 _SPMD_DESC_LEN = 8
@@ -957,7 +958,7 @@ def isend(ctx: SpmdContext, x, dest, tag: int) -> List:
     Returns the raw 3-tensor handle [descriptor, buffer, loopthrough]."""
     perm = _peer_table(ctx, dest, "destination")
     buf = _fresh(x)
-    desc = lax.optimization_barrier(
+    desc = _opt_barrier(
         (jnp.zeros(_SPMD_DESC_LEN, jnp.float32), buf))[0]
     state = _HandleState(kind="send", perm=perm, tag=tag, loop=buf)
     ctx.handles[id(buf)] = state
@@ -974,7 +975,7 @@ def irecv(ctx: SpmdContext, x, source, tag: int) -> List:
     src_table = _peer_table(ctx, source, "source")
     send_perm = _invert_perm(src_table)
     buf = _fresh(x)
-    desc = lax.optimization_barrier(
+    desc = _opt_barrier(
         (jnp.zeros(_SPMD_DESC_LEN, jnp.float32), buf))[0]
     state = _HandleState(kind="recv", perm=send_perm, tag=tag)
     ctx.handles[id(buf)] = state
@@ -1014,7 +1015,7 @@ def wait(ctx: SpmdContext, handle: List):
         # arrives; a send that never matches is caught at region close.
         # Tie the returned loop-through to the descriptor chain so
         # JoinDummiesHandle ordering survives into the compiled program.
-        return lax.optimization_barrier((loop, desc))[0]
+        return _opt_barrier((loop, desc))[0]
     if not state.matched:
         raise DeadlockError(
             f"trace-time deadlock: Wait on a receive (tag {state.tag}, "
@@ -1025,7 +1026,7 @@ def wait(ctx: SpmdContext, handle: List):
             "the Isend first (Isend -> Recv -> Wait, as in the reference "
             "examples), or use Irecv and delay the Wait past the send."
         )
-    return lax.optimization_barrier((state.result, desc))[0]
+    return _opt_barrier((state.result, desc))[0]
 
 
 # ---------------------------------------------------------------------------
@@ -1049,6 +1050,14 @@ class SpmdBackend:
 
     def allreduce(self, x, op):
         return allreduce(self._ctx, x, op)
+
+    def allreduce_compressed(self, x, op, codec):
+        from ..compress import spmd as _cspmd
+        return _cspmd.allreduce(self._ctx, x, op, codec)
+
+    def allgather_compressed(self, x, gatheraxis, codec):
+        from ..compress import spmd as _cspmd
+        return _cspmd.allgather(self._ctx, x, gatheraxis, codec)
 
     def bcast_(self, x, root):
         return bcast_(self._ctx, x, root)
@@ -1221,7 +1230,7 @@ def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
     on every MPI rank (SURVEY.md §3.3).
     """
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from .._compat import shard_map
 
     if mesh is None:
         devs = jax.devices()
@@ -1235,25 +1244,29 @@ def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
         mesh = Mesh(np.asarray(devs[:n]), (axis_name,))
     size = mesh.shape[axis_name]
 
-    def wrapped(det, *args):
+    def wrapped(det, comp, *args):
         ctx = SpmdContext(axis_name=axis_name, size=size)
-        with _bind_spmd(ctx), _config.deterministic_mode(det):
+        with _bind_spmd(ctx), _config.deterministic_mode(det), \
+                _config.compression_scope(comp):
             out = fn(*args)
         return jax.tree.map(lambda y: jnp.expand_dims(y, 0), out)
 
-    def sm(det, *args):
-        return shard_map(lambda *a: wrapped(det, *a), mesh=mesh, in_specs=P(),
-                         out_specs=P(axis_name), check_vma=False)(*args)
+    def sm(det, comp, *args):
+        return shard_map(lambda *a: wrapped(det, comp, *a), mesh=mesh,
+                         in_specs=P(), out_specs=P(axis_name),
+                         check_vma=False)(*args)
 
     if jit:
-        jitted = jax.jit(sm, static_argnums=0)
+        jitted = jax.jit(sm, static_argnums=(0, 1))
     else:
         jitted = sm
 
     def call(*args):
-        # The deterministic-reductions flag is read at *call* time and made
-        # part of the jit cache key (static arg), so toggling it after the
-        # first call retraces instead of silently reusing the old lowering.
-        return jitted(_config.deterministic_reductions(), *args)
+        # The deterministic-reductions flag and the compression default
+        # are read at *call* time and made part of the jit cache key
+        # (static args), so toggling either after the first call retraces
+        # instead of silently reusing the old lowering.
+        return jitted(_config.deterministic_reductions(),
+                      _config.default_compression(), *args)
 
     return call

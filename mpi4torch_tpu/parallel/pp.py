@@ -179,7 +179,8 @@ def pipeline_spmd(comm, apply_stage: Callable[[Any, Any], Any],
     (x, total), _ = jax.lax.scan(
         body, (x0, jnp.zeros(())), jnp.arange(n_steps, dtype=jnp.int32))
     if size > 1:
-        total = comm.Allreduce(total, MPI_SUM)
+        # compression=False: internal loss total (exact-parity contract).
+        total = comm.Allreduce(total, MPI_SUM, compression=False)
     return total
 
 

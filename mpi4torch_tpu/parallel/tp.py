@@ -62,7 +62,9 @@ def column_parallel_linear(comm, x, w_shard, b_shard=None,
     if b_shard is not None:
         y = y + b_shard
     if gather_output:
-        y = comm.Allgather(y, gatheraxis=y.ndim - 1)
+        # compression=False: forward activations — a gradient-compression
+        # scope must not quantize them.
+        y = comm.Allgather(y, gatheraxis=y.ndim - 1, compression=False)
     return y
 
 
@@ -79,7 +81,7 @@ def row_parallel_linear(comm, x_shard, w_shard, b=None,
     would count it ``size`` times)."""
     y = x_shard @ w_shard
     if reduce_output:
-        y = comm.Allreduce(y, MPI_SUM)
+        y = comm.Allreduce(y, MPI_SUM, compression=False)
     elif b is not None:
         raise ValueError(
             "row_parallel_linear(reduce_output=False) cannot add a "

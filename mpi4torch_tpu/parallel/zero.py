@@ -77,7 +77,8 @@ def shard_global_norm(comm, shards):
     equals the unpadded global norm exactly."""
     local_sq = sum(jnp.sum(jnp.square(s))
                    for s in jax.tree.leaves(shards))
-    return jnp.sqrt(comm.Allreduce(local_sq, MPI_SUM))
+    # compression=False: feeds the clipping decision — keep exact.
+    return jnp.sqrt(comm.Allreduce(local_sq, MPI_SUM, compression=False))
 
 
 def zero_init(comm, opt, params):
@@ -156,7 +157,10 @@ def zero3_params(comm, p_shards, template):
     gradient of a rank-local loss w.r.t. the shards IS the global-sum
     gradient shard."""
     def regather(shard, t):
-        full = comm.Allgather(shard, 0)
+        # compression=False: these are updated PARAMETER shards — a
+        # scope-level gradient codec must not quantize them (drift
+        # would accumulate across steps).
+        full = comm.Allgather(shard, 0, compression=False)
         return full[:t.size].reshape(t.shape).astype(t.dtype)
 
     return jax.tree.map(regather, p_shards, template)

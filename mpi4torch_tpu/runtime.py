@@ -390,10 +390,14 @@ def run_ranks(fn: Callable, nranks: int, timeout: float = 60.0,
             primary = failed[0][1]
         secondary = [(r, e) for r, e in failed if e is not primary]
         if secondary:
-            primary.add_note(
-                "other rank failures: "
-                + "; ".join(f"rank {r}: {type(e).__name__}: {e}"
-                            for r, e in secondary)
-            )
+            note = ("other rank failures: "
+                    + "; ".join(f"rank {r}: {type(e).__name__}: {e}"
+                                for r, e in secondary))
+            if hasattr(primary, "add_note"):    # PEP 678, Python >= 3.11
+                primary.add_note(note)
+            else:
+                # 3.10: stash where debuggers can see it; tracebacks
+                # render the primary error unchanged.
+                primary.__notes__ = getattr(primary, "__notes__", []) + [note]
         raise primary
     return results if return_results else []

@@ -45,13 +45,17 @@ def _make_reducers(comm):
     from ..constants import MPI_MAX, MPI_SUM
 
     def dot(a, b):
-        return float(comm.Allreduce(jnp.vdot(a, b), MPI_SUM))
+        # compression=False: line-search control scalars must be exact.
+        return float(comm.Allreduce(jnp.vdot(a, b), MPI_SUM,
+                                    compression=False))
 
     def max_abs(a):
-        return float(comm.Allreduce(jnp.max(jnp.abs(a)), MPI_MAX))
+        return float(comm.Allreduce(jnp.max(jnp.abs(a)), MPI_MAX,
+                                    compression=False))
 
     def sum_abs(a):
-        return float(comm.Allreduce(jnp.sum(jnp.abs(a)), MPI_SUM))
+        return float(comm.Allreduce(jnp.sum(jnp.abs(a)), MPI_SUM,
+                                    compression=False))
 
     return dot, max_abs, sum_abs
 

@@ -385,3 +385,93 @@ class TestDeterminism:
         for r in range(nranks):
             np.testing.assert_array_equal(out1[r], oracle)
             np.testing.assert_array_equal(out1[r], out2[r])
+
+
+class TestDtypeAwareFoldGates:
+    """ADVICE r5 regressions: the fold-delegation gates (Reduce_'s
+    root-only fold, Allreduce's fold-once) must key on the dtype-aware
+    predicate, so an op the dtype rejects (MPI_BAND on floats) raises the
+    SAME informative error on EVERY rank — not a folding-rank death plus
+    broken-barrier aborts elsewhere."""
+
+    def test_reduce_band_on_floats_raises_on_every_rank(self):
+        def body():
+            with pytest.raises(TypeError):
+                comm.Reduce_(jnp.ones(8), mpi.MPI_BAND, 0)
+            return "raised"
+
+        assert run_ranks(body, 3) == ["raised"] * 3
+
+    def test_allreduce_fold_once_band_on_floats_symmetric(self, monkeypatch):
+        from mpi4torch_tpu.ops import eager as eager_mod
+
+        monkeypatch.setattr(eager_mod, "_FOLD_ONCE_MIN", 1)
+
+        def body():
+            with pytest.raises(TypeError):
+                comm.Allreduce(jnp.ones(8), mpi.MPI_BAND)
+            return "raised"
+
+        assert run_ranks(body, 3) == ["raised"] * 3
+
+    def test_fold_applicable_predicate(self):
+        from mpi4torch_tpu import constants as C
+
+        assert C.fold_applicable(mpi.MPI_BAND, np.int32)
+        assert C.fold_applicable(mpi.MPI_BAND, np.bool_)
+        assert not C.fold_applicable(mpi.MPI_BAND, np.float32)
+        assert not C.fold_applicable(mpi.MPI_BXOR, np.float64)
+        assert C.fold_applicable(mpi.MPI_SUM, np.float32)
+        assert C.fold_applicable(mpi.MPI_LAND, np.float32)  # `!= 0` is fine
+        assert not C.fold_applicable(mpi.MPI_MINLOC, np.float32)
+        assert not C.fold_applicable(999, np.int32)
+
+    def test_bitwise_on_ints_still_works_on_fold_once_path(self, monkeypatch):
+        from mpi4torch_tpu.ops import eager as eager_mod
+
+        monkeypatch.setattr(eager_mod, "_FOLD_ONCE_MIN", 1)
+
+        def body():
+            t = jnp.full(8, 1 << comm.rank, jnp.int32)
+            res = comm.Allreduce(t, mpi.MPI_BOR)
+            assert (np.asarray(res) == (1 << comm.size) - 1).all()
+
+        run_ranks(body, 3)
+
+
+class TestFoldOnceSharedResult:
+    """ADVICE r5 regression: the fold-once Allreduce hands every rank the
+    SAME result object; on the numpy path it must be frozen so one rank's
+    in-place edit cannot silently corrupt the others' results."""
+
+    def test_numpy_result_is_readonly(self, monkeypatch):
+        from mpi4torch_tpu.ops import eager as eager_mod
+
+        monkeypatch.setattr(eager_mod, "_FOLD_ONCE_MIN", 1)
+
+        def body(rank):
+            x = np.ones(256, np.float32) * (rank + 1)
+            res = comm.Allreduce(x, mpi.MPI_SUM)
+            if isinstance(res, np.ndarray):
+                assert not res.flags.writeable
+                with pytest.raises((ValueError, RuntimeError)):
+                    res[0] = -1.0
+            return np.asarray(res).copy()
+
+        results = run_ranks(body, 3)
+        for r in results:
+            np.testing.assert_array_equal(r, np.full(256, 6.0, np.float32))
+
+    def test_size_one_world_input_not_frozen(self, monkeypatch):
+        # With one rank the fold returns the caller's own array; freezing
+        # it would be a visible side effect on user data.
+        from mpi4torch_tpu.ops import eager as eager_mod
+
+        monkeypatch.setattr(eager_mod, "_FOLD_ONCE_MIN", 1)
+
+        def body():
+            x = np.ones(64, np.float32)
+            comm.Allreduce(x, mpi.MPI_SUM)
+            assert x.flags.writeable
+
+        run_ranks(body, 1)

@@ -56,6 +56,7 @@ def test_resnet_cifar_dp():
     assert losses0[-1] < losses0[0]
 
 
+@pytest.mark.slow  # multi-minute stencil convergence; TPU-manual lane (tier-1 budget)
 class TestHaloExchangeStencil:
     # Parity config #5: 2D stencil PDE loss over the differentiable
     # Isend/Irecv/Wait halo-exchange ring, solved with the
@@ -169,6 +170,7 @@ def test_tensor_parallel_mlp(nranks):
         assert losses == outs[0]
 
 
+@pytest.mark.slow  # heavyweight MoE compile; TPU-manual lane (tier-1 budget)
 def test_expert_parallel_moe():
     # EP loss and (rank-summed / size) grads equal the per-shard dense
     # oracle at every step (asserted inside main).
@@ -178,6 +180,7 @@ def test_expert_parallel_moe():
         assert losses == outs[0]
 
 
+@pytest.mark.slow  # multi-minute generation loop; TPU-manual lane (tier-1 budget)
 def test_generate_kv_cache():
     # DP training in lock-step, then KV-cache generation equal to the
     # full-forward greedy oracle (asserted inside main); the tiny LM must
@@ -206,3 +209,20 @@ def test_vit_patch_parallel():
         np.testing.assert_array_equal(head0, h)
         np.testing.assert_allclose(sh, si, rtol=1e-5, atol=1e-6)
     assert losses0[-1] < losses0[0]
+
+
+def test_compressed_data_parallel():
+    # Compressed gradient sync (doc/compression.md): the q8_ef and
+    # carried-EF runs must land within 2% of the fp32 baseline loss —
+    # the subsystem's acceptance gate, exercised through the shipped
+    # example itself.  Shortened horizon: the variants track each other
+    # at any step count (tests/test_compress.py gates the full-length
+    # convergence), so the integration test need not re-run it.
+    mod = _load("compressed_data_parallel")
+    mod.STEPS = 60
+    results = mpi.run_ranks(mod.main, 2)
+    fp32, ef, st = results[0]
+    assert abs(ef - fp32) <= 0.02 * fp32
+    assert abs(st - fp32) <= 0.02 * fp32
+    for r in results[1:]:
+        assert r == results[0]   # rank-identical training trajectories

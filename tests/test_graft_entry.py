@@ -2,6 +2,7 @@
 dryrun_multichip() compiles+executes the full distributed step on the
 virtual 8-device CPU mesh, bench.py emits the one-line JSON."""
 
+import pytest
 import json
 import os
 import subprocess
@@ -21,17 +22,20 @@ def test_entry_compiles_and_runs():
     assert out.shape == (4, 128, 256)  # (batch, seq, vocab) logits
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 def test_dryrun_multichip_8():
     # 8 devices: the 3D dp x sp x ep mesh (MoE transformer; DP + ring
     # attention + expert dispatch in one program).
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 def test_dryrun_multichip_4():
     # Non-multiple-of-8: the 2D dp x sp dense-FFN fallback.
     graft.dryrun_multichip(4)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget); runs full bench.py
 def test_bench_json_line():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

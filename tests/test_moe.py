@@ -88,6 +88,7 @@ class TestMoEFFN:
             np.testing.assert_allclose(np.asarray(out[r]), expects[r],
                                        rtol=1e-10, atol=1e-12)
 
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
     def test_grads_match_dense_total_loss(self):
         params, xs = make(2)
 
@@ -132,6 +133,7 @@ class TestMoEFFN:
         np.testing.assert_allclose(outs[0], expect, rtol=1e-12)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 class TestMoETransformer:
     def test_moe_transformer_ep_matches_local_experts(self):
         """MoE-FFN transformer: EP-distributed forward equals the all-

@@ -148,3 +148,45 @@ def test_native_and_fallback_agree_on_dtype_for_all_ops():
         assert np.asarray(fold).dtype == np.float64
         np.testing.assert_array_equal(np.asarray(via_native),
                                       np.asarray(fold))
+
+
+class TestUnknownOpSentinel:
+    """ADVICE r5 regression: unknown/unsupported op codes must come back
+    as a not-handled sentinel (Python sees None and uses the jnp fold),
+    never as a silent identity fold of rank-0's buffer."""
+
+    def test_wrapper_returns_none_for_unknown_op(self):
+        if not _native.available():
+            pytest.skip("no native library")
+        arrays = [np.ones(16, np.float32) * (i + 1) for i in range(3)]
+        assert _native.ordered_reduce(arrays, 999) is None
+
+    def test_raw_entry_point_reports_not_handled(self):
+        if not _native.available():
+            pytest.skip("no native library")
+        import ctypes
+
+        arrays = [np.ones(16, np.float32) * (i + 1) for i in range(3)]
+        out = np.empty(16, np.float32)
+        ptrs = (ctypes.c_void_p * 3)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+        lib = _native._lib
+        # float entry point: bitwise op is integer-only — sentinel, and
+        # unknown codes likewise; supported ops report handled.
+        assert lib.ordered_reduce_f32(
+            ptrs, 3, 16, constants.MPI_BAND,
+            out.ctypes.data_as(ctypes.c_void_p)) != 0
+        assert lib.ordered_reduce_f32(
+            ptrs, 3, 16, 999, out.ctypes.data_as(ctypes.c_void_p)) != 0
+        assert lib.ordered_reduce_f32(
+            ptrs, 3, 16, constants.MPI_SUM,
+            out.ctypes.data_as(ctypes.c_void_p)) == 0
+        np.testing.assert_array_equal(out, np.full(16, 6.0, np.float32))
+
+    def test_integer_entry_point_handles_bitwise(self):
+        if not _native.available():
+            pytest.skip("no native library")
+        arrays = [np.full(16, 1 << i, np.int32) for i in range(3)]
+        res = _native.ordered_reduce(arrays, constants.MPI_BOR)
+        assert res is not None
+        np.testing.assert_array_equal(res, np.full(16, 0b111, np.int32))

@@ -21,7 +21,8 @@ from mpi4torch_tpu import COMM_WORLD as comm
 
 def _lowered_text(fn, *args):
     # debug_info keeps the loc()/name-stack metadata the profiler uses.
-    return jax.jit(fn).lower(*args).as_text(debug_info=True)
+    from mpi4torch_tpu._compat import lowered_text
+    return lowered_text(jax.jit(fn).lower(*args), debug_info=True)
 
 
 class TestNamedScopes:

@@ -167,6 +167,7 @@ class TestRingAttention:
                                    rtol=1e-10, atol=1e-12)
 
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
     def test_spmd_grads_match_dense(self, causal):
         q, k, v = qkv()
 
@@ -273,6 +274,7 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-10, atol=1e-12)
 
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
     def test_spmd_grads_match_dense(self):
         q, k, v = qkv()
 

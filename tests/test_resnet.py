@@ -72,6 +72,7 @@ class TestForward:
         assert logits.shape == (B_GLOBAL, CFG.num_classes)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 class TestDPGradParity:
     """Eval-mode BN makes mean-CE linear in the batch partition: the
     rank-averaged DP gradient must equal the single-rank full-batch
@@ -110,6 +111,7 @@ class TestDPGradParity:
                                        rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 class TestLockStep:
     def test_replicas_identical_and_recipes_agree(self):
         params, state = make_params()
@@ -139,6 +141,7 @@ class TestLockStep:
                                        rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 class TestTraining:
     def test_loss_decreases(self):
         params, state = make_params()

@@ -135,6 +135,7 @@ class TestAllreduceSpmd:
                 got = np.asarray(run(spmd_fn)(data))
                 np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
     def test_ring_fold_reduce_scatter_matches(self, monkeypatch):
         # reduce_scatter's large-payload deterministic path is the
         # relay-routed ring fold (segment s delivered straight to rank s);
@@ -733,7 +734,7 @@ class TestCommFromMesh:
         # src/__init__.py:247-261): use the communicator inside a
         # user-managed shard_map over the user's own axis name.
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from mpi4torch_tpu._compat import shard_map
 
         devs = jax.devices()[:4]
         mesh = Mesh(np.asarray(devs), ("workers",))
@@ -755,7 +756,7 @@ class TestCommFromMesh:
         # fuse into a collective_permute (a fresh context per op call would
         # produce a spurious trace-time DeadlockError).
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from mpi4torch_tpu._compat import shard_map
 
         mesh = Mesh(np.asarray(jax.devices()), ("w",))
         c = mpi.comm_from_mesh(mesh, "w")
@@ -781,7 +782,7 @@ class TestCommFromMesh:
     def test_p2p_scope_matches_and_returns_values(self):
         # Inside an explicit scope the ring still fuses and computes.
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from mpi4torch_tpu._compat import shard_map
 
         mesh = Mesh(np.asarray(jax.devices()), ("w",))
         c = mpi.comm_from_mesh(mesh, "w")
@@ -804,7 +805,7 @@ class TestCommFromMesh:
         # normally only warns from a finalizer; the explicit scope
         # restores run_spmd's hard trace-time DeadlockError.
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from mpi4torch_tpu._compat import shard_map
 
         mesh = Mesh(np.asarray(jax.devices()), ("w",))
         c = mpi.comm_from_mesh(mesh, "w")

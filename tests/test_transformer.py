@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from mpi4torch_tpu._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import mpi4torch_tpu as mpi
@@ -58,6 +58,7 @@ def make_mesh_step(cfg, dp, sp, attn, ep=1):
 
 @pytest.mark.parametrize("attn", ["ring", "ulysses"])
 @pytest.mark.parametrize("dp,sp", [(2, 4), (4, 2), (1, 8), (8, 1)])
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 def test_2d_mesh_matches_single_process(attn, dp, sp):
     # CFG.n_heads = 8 divides every sp in the matrix, so the Ulysses
     # head<->sequence reshuffle runs at ALL mesh shapes (no skips).
@@ -97,6 +98,7 @@ def make_zigzag_mesh_step(cfg, dp, sp):
                              out_specs=P(), check_vma=False))
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 class TestZigzagFlagship:
     """attn='zigzag' through the full distributed step: the load-balanced
     layout must reproduce the single-process run exactly — the boundary
@@ -196,6 +198,7 @@ def test_eager_sp_matches_single_process():
 
 
 @pytest.mark.parametrize("moe", [False, True])
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 def test_remat_preserves_values_and_grads_on_mesh(moe):
     """cfg.remat (jax.checkpoint per block) must be semantics-preserving:
     identical loss and updated params on the distributed step, including
@@ -223,6 +226,7 @@ def test_remat_preserves_values_and_grads_on_mesh(moe):
         params1, params0)
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 def test_remat_single_device_grads_match():
     params, tokens = setup()
     cfg_r = dataclasses.replace(CFG, remat=True)
@@ -238,6 +242,7 @@ def test_remat_single_device_grads_match():
 
 
 @pytest.mark.parametrize("attn,dp,sp", [("ring", 2, 4), ("ulysses", 4, 2)])
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 def test_gqa_2d_mesh_matches_single_process(attn, dp, sp):
     """Grouped-query attention (n_kv_heads < n_heads) through the full
     distributed step: the 2D-mesh GQA transformer must reproduce the
@@ -265,6 +270,7 @@ def test_gqa_2d_mesh_matches_single_process(attn, dp, sp):
 
 
 @pytest.mark.parametrize("attn,dp,sp", [("ring", 1, 8), ("ulysses", 2, 2)])
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 def test_windowed_2d_mesh_matches_single_process(attn, dp, sp):
     """Sliding-window attention (attn_window) through the distributed
     step: windows span sequence-shard boundaries (s_local=2 at sp=8 with
@@ -289,6 +295,7 @@ def test_windowed_2d_mesh_matches_single_process(attn, dp, sp):
         new_params, ref_params)
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 class TestRoPE:
     """Rotary position embeddings: relative encoding applied to q/k
     before any transport, so distributed strategies need no special
@@ -366,6 +373,7 @@ class TestRoPE:
                                 n_layers=1, d_ff=8, max_seq=8, rope=True)
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 class TestModernArchitecture:
     """RMSNorm + SwiGLU (+ rope/GQA/window): the llama-family block
     variants must satisfy their defining formulas and reproduce the
@@ -513,6 +521,7 @@ class TestChunkedVocabLoss:
             T.lm_loss(self.VCFG, params, tokens, vocab_chunk=5)
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 class TestZeroTrainStep:
     """zero_train_step: ZeRO-1 over dp composed with sp inside the
     flagship — must reproduce the replicated-DP optax trajectory."""
@@ -624,6 +633,7 @@ class TestZeroTrainStep:
             new_params, ref_p)
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 class TestZero3TrainStep:
     """zero3_train_step: parameters live as 1/dp shards BETWEEN steps;
     the dp reduction rides the Allgather adjoint.  Must reproduce the
@@ -685,6 +695,7 @@ def test_gqa_bad_head_ratio_raises():
         dataclasses.replace(CFG, n_kv_heads=3)
 
 
+@pytest.mark.slow  # multi-minute oracle compile; TPU/manual lane (tier-1 budget)
 class TestDecoding:
     """KV-cache incremental decoding must be exactly the training forward
     read one position at a time (teacher-forcing equivalence) — including

@@ -152,6 +152,7 @@ class TestPatchParallel:
             np.testing.assert_allclose(np.asarray(o), np.asarray(want),
                                        rtol=1e-10, atol=1e-12)
 
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
     def test_sp_grads_flow_and_match(self):
         cfg = V.ViTConfig(image_hw=4, patch=2, d_model=16, n_heads=2,
                           n_layers=1, d_ff=16, num_classes=3)

@@ -258,6 +258,7 @@ def _checkpoint_resume_harness(tmp_path, init_fn, step_fn, final_fn):
             got, want)
 
 
+@pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
 def test_zero_state_checkpoint_resume(tmp_path):
     """Crash/resume with SHARDED optimizer state: each rank saves its
     own shard, restores it, and the resumed trajectory is identical to
@@ -362,7 +363,7 @@ class TestZero3:
         # (params, forward) + reduce-scatters (gradient adjoint) — and
         # crucially NO all_reduce (a full gradient allreduce would mean
         # the sharding saved nothing on the wire).
-        from jax import shard_map
+        from mpi4torch_tpu._compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
         from mpi4torch_tpu.parallel import zero3_init, zero3_step
 
@@ -385,6 +386,7 @@ class TestZero3:
         assert txt.count("stablehlo.reduce_scatter") >= 1
         assert txt.count("stablehlo.all_reduce") == 0, txt
 
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
     def test_zero3_state_checkpoint_resume(self, tmp_path):
         """Crash/resume with SHARDED PARAMETERS: each rank persists its
         1/size parameter shard + optimizer shard (the whole point of
