@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tpu-test native clean-docs
+.PHONY: docs test tier1 tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -12,6 +12,19 @@ docs:
 
 test:
 	python -m pytest tests/ -q
+
+# The exact ROADMAP.md tier-1 verify command (budgeted, CPU-pinned, with
+# the dot-census the driver greps) — run this before shipping a PR.
+# bash, not sh: the command uses pipefail/PIPESTATUS.
+tier1: SHELL := /bin/bash
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+		| tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
+		| tr -cd . | wc -c); exit $$rc
 
 # Hardware-gated subset: requires a real TPU.  The escape hatch opens the
 # conftest platform gate (which otherwise pins cpu, regardless of any

@@ -287,6 +287,122 @@ def _bench_allreduce_compressed(on_tpu: bool):
     return out
 
 
+def _bench_allreduce_fused(on_tpu: bool):
+    """Fused bucketed vs per-leaf Allreduce on a real DP ResNet gradient
+    tree (mpi4torch_tpu.fuse, ISSUE 2): collective-launch counts read off
+    the lowered StableHLO (ground truth on any platform), bytes-on-wire,
+    and wall-clock per step — each with and without the q8 codec.  The
+    acceptance bar: >= 5x fewer launches fused, wall-time no worse."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu._compat import shard_map
+    from mpi4torch_tpu.compress import get_codec
+    from mpi4torch_tpu.fuse import bucket_layout
+    from mpi4torch_tpu.models import resnet as R
+
+    n = len(jax.devices())
+    # ResNet-18-ish widths on TPU; a narrow stack on the CPU smoke path.
+    if on_tpu:
+        cfg = R.ResNetConfig()
+        iters = 20
+    else:
+        cfg = R.ResNetConfig(widths=(8, 16, 32, 64),
+                             stage_sizes=(2, 2, 2, 2), num_classes=10)
+        iters = 3
+    params, _state = R.init_resnet(jax.random.PRNGKey(0), cfg)
+    grads = jax.tree.map(jnp.asarray, params)   # stand-in gradient tree
+    leaves = jax.tree.leaves(grads)
+    total_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("w",))
+    comm = mpi.comm_from_mesh(mesh, "w")
+
+    COLL = ("all_reduce", "all_gather", "reduce_scatter",
+            "collective_permute", "all_to_all")
+
+    def launches(fn):
+        wrapped = shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                            check_vma=False)
+        txt = jax.jit(wrapped).lower(grads).as_text()
+        return sum(txt.count(f"stablehlo.{c}") for c in COLL)
+
+    def perleaf(compression):
+        def f(t):
+            return jax.tree.map(
+                lambda g: comm.Allreduce(g, mpi.MPI_SUM,
+                                         compression=compression)
+                / comm.size, t)
+        return f
+
+    def fused(compression):
+        def f(t):
+            return comm.Allreduce_tree(t, mpi.MPI_SUM, mean=True,
+                                       compression=compression)
+        return f
+
+    def timed(fn):
+        step = mpi.run_spmd(fn, mesh=mesh, axis_name="w")
+        return _timeit(step, grads, iters=iters)
+
+    layout = bucket_layout(grads, mpi.config.default_bucket_bytes())
+    out = {
+        "n_devices": n,
+        "n_leaves": len(leaves),
+        "n_buckets": layout.num_buckets,
+        "grad_tree_mib": round(total_bytes / (1 << 20), 3),
+        "bucket_bytes": mpi.config.default_bucket_bytes(),
+        "variants": {},
+    }
+
+    codec = get_codec("q8")
+    q8_leaf_bytes = sum(codec.wire_bytes(x.shape, x.dtype) for x in leaves)
+    q8_bucket_bytes = sum(
+        codec.wire_bytes((sz,), dt)
+        for sz, dt in zip(layout.bucket_sizes, layout.bucket_dtypes))
+    for name, compression, wire in (
+            ("perleaf_fp32", False, total_bytes),
+            ("fused_fp32", False, total_bytes),
+            ("perleaf_q8", "q8", q8_leaf_bytes),
+            ("fused_q8", "q8", q8_bucket_bytes)):
+        build = fused if name.startswith("fused") else perleaf
+
+        def _one(build=build, compression=compression, wire=wire):
+            return {
+                "launches": launches(build(compression)),
+                "wire_bytes": int(wire),
+                "seconds_per_step": timed(build(compression)),
+            }
+
+        out["variants"][name] = _guarded(f"allreduce_fused.{name}", _one)
+
+    pl, fu = out["variants"].get("perleaf_fp32", {}), \
+        out["variants"].get("fused_fp32", {})
+    if "launches" in pl and "launches" in fu:
+        out["launch_reduction"] = round(
+            pl["launches"] / max(fu["launches"], 1), 2)
+        out["step_speedup_vs_perleaf"] = round(
+            pl["seconds_per_step"] / fu["seconds_per_step"], 4)
+        out["launch_reduction_target_met"] = bool(
+            out["launch_reduction"] >= 5.0)
+        # One device: a 1-rank psum compiles to identity, so the
+        # per-leaf "collectives" are free while the fused path still
+        # pays its concat/slice HBM traffic — the wall-time verdict only
+        # means something where a wire exists, so (like the allreduce
+        # stanza's roofline handling) it is None rather than a spurious
+        # false on the single-chip harness.
+        out["walltime_no_worse"] = (
+            bool(fu["seconds_per_step"] <= pl["seconds_per_step"] * 1.05)
+            if n > 1 else None)
+        if n == 1:
+            out["note"] = ("single device: no wire; launch counts are "
+                           "ground truth, wall-time comparison is not")
+    return out
+
+
 def _bench_flash(on_tpu: bool, peak: float):
     """Causal flash-attention fwd+bwd achieved FLOP/s and MFU."""
     import jax
@@ -739,6 +855,7 @@ def main() -> None:
         ar = _guarded("allreduce", _bench_allreduce, on_tpu, hbm)
         arc = _guarded("allreduce_compressed", _bench_allreduce_compressed,
                        on_tpu)
+        arf = _guarded("allreduce_fused", _bench_allreduce_fused, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -768,6 +885,7 @@ def main() -> None:
             "cpu_requested": cpu_pinned,
             "allreduce": ar,
             "allreduce_compressed": arc,
+            "allreduce_fused": arf,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

@@ -61,7 +61,8 @@ from .distributed import (
 )
 from . import config
 from . import compress
-from .config import compression_scope
+from . import fuse
+from .config import compression_scope, fusion_scope
 
 __all__ = [
     # reference __all__ (src/__init__.py:5-25)
@@ -101,7 +102,9 @@ __all__ = [
     "PermRank",
     "config",
     "compress",
+    "fuse",
     "compression_scope",
+    "fusion_scope",
     "CommError",
     "CollectiveMismatchError",
     "DeadlockError",

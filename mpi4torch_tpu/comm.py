@@ -113,10 +113,13 @@ def _codec_for(tensor, codec, explicit):
     degrades those to the exact path (enabling gradient compression must
     not corrupt unrelated integer collectives); an EXPLICIT per-call
     ``compression=`` on a non-float tensor is a misuse and raises, like
-    the facade's other explicit-argument checks."""
+    the facade's other explicit-argument checks.  The fused bucket path
+    (mpi4torch_tpu.fuse) applies the same gate per bucket via
+    :func:`mpi4torch_tpu.compress.codec_applicable`."""
+    from .compress import codec_applicable
     if codec is None:
         return None
-    if not jnp.issubdtype(jnp.result_type(tensor), jnp.floating):
+    if not codec_applicable(codec, jnp.result_type(tensor)):
         if explicit:
             raise ValueError(
                 f"compression={codec.name!r} requires a floating tensor; "
@@ -219,6 +222,34 @@ class MPI_Communicator:
             if codec is None:
                 return self._backend().allreduce(tensor, op)
             return self._backend().allreduce_compressed(tensor, op, codec)
+
+    def Allreduce_tree(self, tree, op: int, compression=None,
+                       bucket_bytes=None, mean: bool = False,
+                       overlap=None):
+        """Fused bucketed Allreduce over a whole pytree
+        (:mod:`mpi4torch_tpu.fuse`): the leaves are flattened into
+        dtype-homogeneous flat buckets of ~``bucket_bytes`` (layout
+        cached per tree structure) and each bucket rides ONE collective
+        — under SPMD, one ring reduce-scatter + all-gather pair —
+        instead of one launch per leaf, with consecutive buckets staged
+        to overlap.  Semantically equivalent to mapping
+        :meth:`Allreduce` over the leaves (and bit-identical to it on
+        the eager backend); AD-transparent like every facade op — the
+        backward pass is itself fused bucketed communication.
+
+        ``bucket_bytes=None`` uses the :func:`config.fusion_scope` /
+        process default (~4 MiB); ``0`` opts out (per-leaf ops).
+        ``mean=True`` additionally divides each reduced bucket by
+        :attr:`size` once — the DP rank-mean as a single post-fuse
+        scale (MPI_SUM only).  ``compression`` follows the
+        :meth:`Allreduce` contract, applied per bucket.  ``overlap``
+        picks the scheduler (None = backend default; see
+        :func:`mpi4torch_tpu.fuse.fused_allreduce_tree`)."""
+        from .fuse import fused_allreduce_tree
+        with jax.named_scope("mpi4torch.Allreduce_tree"):
+            return fused_allreduce_tree(
+                self, tree, op, compression=compression,
+                bucket_bytes=bucket_bytes, mean=mean, overlap=overlap)
 
     @_named_op
     def Bcast_(self, tensor, root: int):

@@ -40,7 +40,24 @@ from .codecs import (BF16Codec, BF16StochasticCodec, BlockQ8Codec, Codec,
                      register_codec)
 from .ef import ef_allreduce, ef_init
 
+
+def codec_applicable(codec, dtype) -> bool:
+    """True when ``codec`` may legally touch a tensor of ``dtype``.
+
+    Quantizing integer/bool payloads (counts, masks, descriptors) would
+    silently truncate rather than approximate, so only floating tensors
+    are compressible.  This is THE dtype gate — the facade applies it
+    per tensor (comm.py ``_codec_for``) and the fused bucketed
+    collectives per dtype-homogeneous bucket (fuse/collectives.py), so
+    the degrade/raise behavior cannot drift between the two paths."""
+    import jax.numpy as jnp
+
+    return codec is not None and jnp.issubdtype(jnp.dtype(dtype),
+                                                jnp.floating)
+
+
 __all__ = [
+    "codec_applicable",
     "Codec",
     "BlockQ8Codec",
     "BF16Codec",

@@ -18,6 +18,15 @@ the :func:`compression_scope` context manager.  Like the deterministic
 flag, the value is read at *trace* time: ``run_spmd`` makes it part of the
 jit cache key so toggling retraces, but a user-managed ``jax.jit`` that
 already traced keeps its lowering until it retraces.
+
+``default_bucket_bytes`` — the target flat-bucket size of the fused tree
+collectives (mpi4torch_tpu.fuse; the per-leaf→per-bucket launch
+reduction).  ~4 MiB default, the production-stack sweet spot between
+launch amortization and overlap granularity.  Set process-wide with
+:func:`set_default_bucket_bytes` or lexically with :func:`fusion_scope`;
+``fusion_scope(0)`` disables fusion (per-leaf collectives) for the
+block.  Read at trace time like the other knobs; ``run_spmd`` keys its
+jit cache on it.
 """
 
 from __future__ import annotations
@@ -76,6 +85,66 @@ def set_default_compression(codec) -> None:
     :func:`compression_scope` overrides it."""
     global _process_default
     _process_default = _validated(codec)
+
+
+# Fused-collective bucket size (mpi4torch_tpu.fuse).  4 MiB: large enough
+# to amortize per-collective launch + ring latency over hundreds of tiny
+# leaves, small enough that a grad tree still splits into several buckets
+# whose transfers the overlap scheduler can keep in flight concurrently.
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+_process_bucket_bytes = DEFAULT_BUCKET_BYTES
+
+
+def default_bucket_bytes() -> int:
+    """Bucket size (bytes) the fused tree collectives use when no
+    explicit ``bucket_bytes=`` is passed: the innermost active
+    :func:`fusion_scope` on this thread, else the process-wide
+    :func:`set_default_bucket_bytes` value.  ``0`` disables fusion
+    (per-leaf collectives)."""
+    scoped = getattr(_state, "bucket_bytes", _UNSET)
+    return _process_bucket_bytes if scoped is _UNSET else scoped
+
+
+def _validated_bucket_bytes(nbytes) -> int:
+    if nbytes is False:
+        return 0
+    nbytes = int(nbytes)
+    if nbytes < 0:
+        raise ValueError(f"bucket_bytes must be >= 0, got {nbytes}")
+    return nbytes
+
+
+def set_default_bucket_bytes(nbytes) -> None:
+    """Set the process-wide fused-collective bucket size in bytes
+    (``0``/``False`` = fusion off → per-leaf collectives)."""
+    global _process_bucket_bytes
+    _process_bucket_bytes = _validated_bucket_bytes(nbytes)
+
+
+@contextmanager
+def fusion_scope(bucket_bytes):
+    """Lexically scoped bucket size for the fused tree collectives::
+
+        with mpi.config.fusion_scope(1 << 20):   # 1 MiB buckets
+            grads = comm.Allreduce_tree(grads, mpi.MPI_SUM, mean=True)
+
+        with mpi.config.fusion_scope(0):         # per-leaf, unfused
+            ...
+
+    Per-thread like :func:`compression_scope` (a scope opened before
+    ``run_ranks`` is not seen by the rank-threads — use
+    :func:`set_default_bucket_bytes` or open the scope inside the rank
+    body).  ``run_spmd`` re-reads the value at call time and makes it
+    part of its jit cache key, so toggling retraces."""
+    prev = getattr(_state, "bucket_bytes", _UNSET)
+    _state.bucket_bytes = _validated_bucket_bytes(bucket_bytes)
+    try:
+        yield
+    finally:
+        if prev is _UNSET:
+            del _state.bucket_bytes
+        else:
+            _state.bucket_bytes = prev
 
 
 @contextmanager

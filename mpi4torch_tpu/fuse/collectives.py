@@ -1,0 +1,363 @@
+"""Fused bucketed tree collectives with compute/communication overlap.
+
+One collective (pair) per *bucket* instead of per leaf:
+
+* :func:`fused_allreduce_tree` — the DP primitive.  Mode A (SPMD mesh)
+  lowers each exact-SUM bucket to a single ring **reduce-scatter +
+  all-gather pair** over the flat buffer (the two halves of a ring
+  allreduce, visible as one ``stablehlo.reduce_scatter`` + one
+  ``stablehlo.all_gather`` per bucket in the lowered program) and stages
+  consecutive buckets through a differentiable ``optimization_barrier``
+  interleave so bucket ``i``'s all-gather is issued only after bucket
+  ``i+1``'s reduce-scatter — at least two collectives in flight while
+  the result of the first is still being consumed.  Mode B (eager
+  thread-SPMD) runs one rendezvous collective per bucket (bit-identical
+  to the per-leaf ascending-rank fold), or — with ``overlap=True`` —
+  the :func:`_pipeline_allreduce` schedule: nonblocking per-bucket
+  gather-fold collectives built from the existing ``Isend``/``Irecv``/
+  ``WaitHandle`` machinery, issuing bucket ``i+1``'s transfers before
+  waiting on bucket ``i`` (``JoinDummiesHandle`` chains the issue
+  order; the buffered eager sends make the overlap real).
+
+* :func:`fused_reduce_scatter_tree` / :func:`fused_allgather_tree` —
+  the ZeRO pair: block buckets whose row ``r`` concatenates every member
+  leaf's ``r``-th padded segment, so one axis-0 ``Reduce_scatter``
+  (→ ``lax.psum_scatter`` under SPMD) or one ``Allgather`` moves every
+  leaf's shard at once (parallel/zero.py rides these by default).
+
+AD transparency is compositional: bucketing is differentiable
+reshape/concat/slice glue (fuse/bucketing.py) and every collective here
+is the facade's own ``custom_vjp`` op, so the backward pass of a fused
+bucketed collective is itself fused bucketed communication — the
+adjoint of the reduce-scatter + all-gather pair is the same pair on the
+cotangent buckets, in reverse bucket order.
+
+Compression composes per bucket: ``compression="q8"`` (or an active
+``compression_scope``) sends each float bucket through the quantized
+ring pipeline of :mod:`mpi4torch_tpu.compress` — fused buckets are also
+quantized, with the facade's degrade/raise dtype rules applied
+per-bucket (a scope default leaves integer buckets exact; an explicit
+codec on a non-float bucket raises).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import config as _config
+from .. import constants as C
+from .._compat import optimization_barrier as _opt_barrier
+from ..ops.spmd import _ring_table
+from ..runtime import CommError
+from ..utils.profiling import bucket_scope
+from .bucketing import (flatten_buckets, flatten_shard_buckets,
+                        flatten_shard_rows, shard_layout,
+                        unflatten_buckets, unflatten_gathered,
+                        unflatten_shard_rows)
+
+# Tag block reserved for the eager overlap pipeline: high enough to stay
+# clear of user p2p tags; each bucket consumes a stride of
+# (size + GRAD_TAG_OFFSET + 1) tags so a bucket's gradient tags
+# (tag + 10, ops/eager.py) can never collide with another bucket's
+# forward tags.
+FUSE_TAG_BASE = 1 << 20
+
+
+def _resolve_bucket_bytes(bucket_bytes) -> int:
+    if bucket_bytes is None:
+        return _config.default_bucket_bytes()
+    # Same validation as the config setters: a negative size is a caller
+    # bug, not a request for the per-leaf path.
+    return _config._validated_bucket_bytes(bucket_bytes)
+
+
+def _is_mode_a(comm) -> bool:
+    """True when the communicator currently resolves to the SPMD mesh
+    backend (single-trace Mode A) rather than the eager thread runtime."""
+    from ..ops.spmd import SpmdBackend
+    return isinstance(comm._backend(), SpmdBackend)
+
+
+def _bucket_codec(comm, bucket, codec, op: int, explicit: bool):
+    """The facade's per-tensor compression rules, applied per bucket:
+    scope defaults degrade non-float buckets and non-SUM ops to exact;
+    an explicit codec on a non-float bucket raises (comm._codec_for)."""
+    from ..comm import _codec_for
+    bcodec = _codec_for(bucket, codec, explicit)
+    if bcodec is not None and op != C.MPI_SUM and not explicit:
+        bcodec = None
+    return bcodec
+
+
+def _pipeline_allreduce(comm, buckets: Sequence, op: int, *,
+                        depth: int = 2):
+    """Eager overlap scheduler: nonblocking per-bucket sum-allreduce.
+
+    Each bucket's collective is the gather+ascending-rank-fold form
+    posted through the existing WaitHandle machinery — ``size-1``
+    buffered ``Isend``/``Irecv`` pairs per bucket (payloads land in the
+    destination mailboxes immediately; nothing blocks until ``Wait``).
+    The scheduler keeps ``depth`` buckets in flight: bucket ``i+1``'s
+    transfers are issued before bucket ``i``'s ``Wait``s, and
+    ``JoinDummiesHandle`` chains each bucket's receives onto the
+    previous bucket's send descriptor so the issue order is explicit in
+    the dependency graph.  The fold is the same ascending-rank
+    association as the rendezvous path — results are bit-identical to
+    it (and to the per-leaf path).  Gradients need no extra code: the
+    ``Isend``/``Irecv``/``Wait`` custom VJPs route each peer's cotangent
+    back over ``tag + 10``, so the backward pass is the same pipeline
+    in the reverse direction.
+    """
+    from ..comm import JoinDummies, JoinDummiesHandle
+
+    if op != C.MPI_SUM:
+        raise CommError(
+            "the fused overlap pipeline supports MPI_SUM only; pass "
+            "overlap=False (per-bucket rendezvous collectives) for other "
+            "reductions")
+    from ..ops.eager import GRAD_TAG_OFFSET
+
+    n, rank = comm.size, comm.rank
+    nb = len(buckets)
+    if n == 1 or nb == 0:
+        return [jnp.asarray(b) for b in buckets]
+    # Per-bucket tag block: n-1 forward tags plus their tag+10 gradient
+    # shadow — the next bucket's block starts past both, so a slow rank's
+    # forward receive can never swallow a fast rank's backward gradient.
+    stride = n + GRAD_TAG_OFFSET + 1
+    outs: list = [None] * nb
+    pending: collections.deque = collections.deque()
+    prev_send = [None]
+
+    def start(i: int) -> None:
+        b = jnp.asarray(buckets[i])
+        tag0 = FUSE_TAG_BASE + i * stride
+        sends, recvs = [], []
+        for off in range(1, n):
+            sends.append(comm.Isend(b, _ring_table(n, off), tag0 + off))
+            recvs.append(comm.Irecv(jnp.zeros_like(b),
+                                    _ring_table(n, n - off), tag0 + off))
+        # Chain every receive onto this bucket's sends (and the previous
+        # bucket's last send, pinning issue order across buckets).  The
+        # forward edge send -> recv-Wait is what makes the BACKWARD
+        # deadlock-free: it reverses into recvWait-bwd -> isend-bwd, so
+        # each rank posts its (buffered) gradient sends before blocking
+        # in an Isend VJP's gradient receive.  Without the edge the two
+        # backward chains are independent and the autodiff scheduler may
+        # run the blocking receives first — observed as a symmetric
+        # all-rank deadlock on the last bucket.
+        dummies = [h.dummy for h in sends]
+        if prev_send[0] is not None:
+            dummies.append(prev_send[0].dummy)
+        recvs = [JoinDummiesHandle(r, dummies) for r in recvs]
+        prev_send[0] = sends[-1]
+        pending.append((i, b, sends, recvs))
+
+    def finish() -> None:
+        i, b, sends, recvs = pending.popleft()
+        vals: list = [None] * n
+        vals[rank] = b
+        for off, r in enumerate(recvs, start=1):
+            vals[(rank - off) % n] = comm.Wait(r)
+        out = C.reduce_ordered(op, vals)
+        # Completing the sends through JoinDummies keeps every Isend on
+        # the differentiation path even though its Wait output is a pure
+        # dependency token — the backward's remote-gradient receives
+        # must run on all ranks symmetrically (ops/eager.py isend bwd).
+        outs[i] = JoinDummies(out, [comm.Wait(h) for h in sends])
+
+    for i in range(nb):
+        with bucket_scope("Iallreduce_tree", i, nb):
+            start(i)
+        if len(pending) >= max(int(depth), 1):
+            finish()
+    while pending:
+        finish()
+    return outs
+
+
+def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
+                         compression=None, bucket_bytes=None,
+                         mean: bool = False,
+                         overlap: Optional[bool] = None):
+    """Allreduce every leaf of ``tree`` through dtype-homogeneous flat
+    buckets — one collective (pair) per bucket instead of per leaf.
+
+    ``bucket_bytes``: target bucket size (None → the ``fusion_scope`` /
+    process default, ~4 MiB; 0/False → unfused per-leaf ops).
+    ``mean=True`` divides each reduced bucket by ``comm.size`` once —
+    the DP rank-mean as a single post-fuse scale per bucket (MPI_SUM
+    only).  ``compression`` follows the facade's Allreduce contract,
+    applied per bucket.  ``overlap``: None picks the backend default
+    (SPMD: barrier-staged interleave on; eager: rendezvous collectives);
+    ``True`` under the eager runtime switches to the nonblocking
+    Isend/Irecv pipeline (:func:`_pipeline_allreduce`) — exact MPI_SUM
+    only; requesting it with a codec or another reduction raises rather
+    than silently degrading to the blocking rendezvous."""
+    if mean and op != C.MPI_SUM:
+        raise CommError(
+            f"mean=True is the rank-mean of an MPI_SUM reduction; got "
+            f"{C.op_name(op)}")
+    bb = _resolve_bucket_bytes(bucket_bytes)
+    size = comm.size
+    mode_a = _is_mode_a(comm)
+    explicit = compression is not None
+    from ..comm import _resolve_compression
+    codec = _resolve_compression(compression)
+
+    if not mode_a and overlap:
+        # Explicit overlap request on the eager backend: the pipeline is
+        # exact-SUM-only, and silently falling back to the blocking
+        # rendezvous path would leave the caller believing they got the
+        # nonblocking schedule — fail loudly instead.  Validated before
+        # the fusion-off early return so the argument check does not
+        # depend on ambient fusion_scope state.
+        if op != C.MPI_SUM:
+            raise CommError(
+                "the fused overlap pipeline supports MPI_SUM only; pass "
+                "overlap=False (per-bucket rendezvous collectives) for "
+                f"{C.op_name(op)} reductions")
+        if codec is not None:
+            raise CommError(
+                "the fused overlap pipeline is exact-only; compressed "
+                f"buckets (codec {codec.name!r}"
+                + ("" if explicit else ", from the active "
+                   "compression_scope/process default") +
+                ") take the per-bucket rendezvous path — pass "
+                "overlap=False, or compression=False to pipeline exact")
+
+    if bb <= 0:
+        out = jax.tree.map(
+            lambda p: comm.Allreduce(p, op, compression=compression), tree)
+        if mean:
+            out = jax.tree.map(lambda p: p / size, out)
+        return out
+
+    buckets, layout = flatten_buckets(tree, bb)
+    nb = layout.num_buckets
+
+    if not mode_a and overlap:
+        reduced = _pipeline_allreduce(comm, buckets, op)
+        if mean:
+            reduced = [b / size for b in reduced]
+        return unflatten_buckets(reduced, layout)
+
+    # Phase 1: issue every bucket's reduction.  Exact-SUM buckets on the
+    # SPMD mesh take the explicit reduce-scatter half of the ring (the
+    # all-gather half is phase 2, so consecutive buckets overlap);
+    # everything else — eager rendezvous, compressed, non-SUM,
+    # deterministic-ordered — is a whole collective through the facade,
+    # one launch per bucket either way.
+    use_pair = (mode_a and op == C.MPI_SUM and size > 1
+                and not _config.deterministic_reductions())
+    stage = []
+    for i, b in enumerate(buckets):
+        bcodec = _bucket_codec(comm, b, codec, op, explicit)
+        with bucket_scope("Allreduce_tree", i, nb, codec=bcodec):
+            if bcodec is not None or not use_pair:
+                # Re-resolution guard: the degrade decision was already
+                # made here, so hand the facade the resolved codec, or
+                # False to pin exact (compression=None would re-read the
+                # scope default and re-apply a codec this bucket — or an
+                # explicit compression=False — just opted out of).
+                arg = bcodec if bcodec is not None else (
+                    False if (codec is not None or explicit) else None)
+                out = comm.Allreduce(b, op, compression=arg)
+                stage.append(("whole", i, out, None))
+            else:
+                seg = -(-b.size // size)
+                padded = b
+                if seg * size != b.size:
+                    padded = jnp.concatenate(
+                        [b, jnp.zeros((seg * size - b.size,), b.dtype)])
+                part = comm.Reduce_scatter(padded.reshape(size, seg), op, 0)
+                stage.append(("part", i, part, b.size))
+
+    # Overlap staging: tie bucket i's scattered part to bucket i+1's
+    # through a differentiable optimization_barrier, so bucket i's
+    # all-gather cannot be issued (or hoisted) before bucket i+1's
+    # reduce-scatter — the staged interleave keeps >= 2 collectives in
+    # flight without adding any wire traffic.
+    part_idx = [k for k, s in enumerate(stage) if s[0] == "part"]
+    if overlap is not False and len(part_idx) > 1:
+        orig = [stage[k][2] for k in part_idx]
+        for j in range(len(part_idx) - 1):
+            k = part_idx[j]
+            kind, i, _, nelem = stage[k]
+            tied = _opt_barrier((orig[j], orig[j + 1]))[0]
+            stage[k] = (kind, i, tied, nelem)
+
+    # Phase 2: complete — all-gather the scattered parts, unpad, scale.
+    reduced = [None] * nb
+    for kind, i, val, nelem in stage:
+        if kind == "part":
+            with bucket_scope("Allreduce_tree", i, nb):
+                full = comm.Allgather(val, 0, compression=False)
+                val = full.reshape(-1)[:nelem]
+        reduced[i] = val / size if mean else val
+    return unflatten_buckets(reduced, layout)
+
+
+def fused_reduce_scatter_tree(comm, tree, op: int = C.MPI_SUM, *,
+                              bucket_bytes=None, mean: bool = False):
+    """Reduce-scatter every leaf of ``tree`` in block buckets: returns
+    the tree of this rank's flat per-leaf shards (length
+    ``ceil(leaf.size / size)`` each, zero-padded — the ZeRO gradient
+    representation of parallel/zero.py), computed with ONE
+    ``Reduce_scatter`` per bucket (→ one native ``psum_scatter`` under
+    SPMD).  ``mean=True`` divides each shard bucket by ``comm.size``
+    once (MPI_SUM only).  Always exact (the ZeRO internals are pinned
+    exact; see compress docs)."""
+    if mean and op != C.MPI_SUM:
+        raise CommError(
+            f"mean=True is the rank-mean of an MPI_SUM reduction; got "
+            f"{C.op_name(op)}")
+    bb = _resolve_bucket_bytes(bucket_bytes)
+    size = comm.size
+    if bb <= 0:
+        def per_leaf(g):
+            flat = jnp.asarray(g).reshape(-1)
+            per = -(-flat.shape[0] // size)
+            padded = jnp.pad(flat, (0, per * size - flat.shape[0]))
+            rs = comm.Reduce_scatter(padded, op, 0)
+            return rs / size if mean else rs
+        return jax.tree.map(per_leaf, tree)
+
+    buckets, layout = flatten_shard_buckets(tree, size, bb)
+    rows = []
+    for i, b in enumerate(buckets):
+        with bucket_scope("Reduce_scatter_tree", i, layout.num_buckets):
+            row = comm.Reduce_scatter(b, op, 0).reshape(-1)
+        rows.append(row / size if mean else row)
+    return unflatten_shard_rows(rows, layout)
+
+
+def fused_allgather_tree(comm, shard_tree, template, *, bucket_bytes=None):
+    """Gather a tree of flat per-leaf shards (the output shape of
+    :func:`fused_reduce_scatter_tree` /
+    :func:`~mpi4torch_tpu.parallel.zero.zero3_shard_params`) back into
+    full leaves shaped like ``template``, with ONE ``Allgather`` per
+    bucket.  Differentiable: the adjoint is the fused per-bucket
+    reduce-scatter of the cotangents (the ZeRO-3 wire pattern).  Always
+    exact — parameter shards must not ride a lossy codec."""
+    bb = _resolve_bucket_bytes(bucket_bytes)
+    size = comm.size
+    if bb <= 0:
+        def per_leaf(shard, t):
+            full = comm.Allgather(shard, 0, compression=False)
+            return full[:t.size].reshape(t.shape).astype(t.dtype)
+        return jax.tree.map(per_leaf, shard_tree, template)
+
+    layout = shard_layout(template, size, bb)
+    rows = flatten_shard_rows(shard_tree, layout)
+    blocks = []
+    for i, row in enumerate(rows):
+        with bucket_scope("Allgather_tree", i, layout.num_buckets):
+            full = comm.Allgather(row, 0, compression=False)
+        blocks.append(full.reshape(size, -1))
+    out = unflatten_gathered(blocks, layout)
+    return jax.tree.map(lambda x, t: x.astype(t.dtype), out, template)

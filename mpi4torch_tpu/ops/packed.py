@@ -30,6 +30,7 @@ mesh backend and cross-checks the two backends slot for slot.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -68,20 +69,33 @@ def _mask_valid(x, axis: int, count):
     return jnp.where(pos < count, x, jnp.zeros((), x.dtype))
 
 
-def _pack_index(counts: Sequence[int], capacity: int) -> np.ndarray:
+def _frozen(a: np.ndarray) -> np.ndarray:
+    # lru_cache hands the SAME ndarray to every caller; the index maps
+    # are read-only by contract (jnp.take operands) — freeze so an
+    # accidental in-place edit cannot corrupt every later call.
+    a.flags.writeable = False
+    return a
+
+
+@functools.lru_cache(maxsize=512)
+def _pack_index(counts: Tuple[int, ...], capacity: int) -> np.ndarray:
     """Static index map from the (size*capacity) block layout to the
-    packed sum(counts) layout: packed slot offsets[r]+i <- r*capacity+i."""
-    return np.concatenate(
+    packed sum(counts) layout: packed slot offsets[r]+i <- r*capacity+i.
+    Memoized on the (counts, capacity) tuple: every traced call of a
+    packed collective rebuilt the identical ndarray."""
+    return _frozen(np.concatenate(
         [np.arange(r * capacity, r * capacity + c, dtype=np.int64)
          for r, c in enumerate(counts)]
-        or [np.zeros(0, np.int64)])
+        or [np.zeros(0, np.int64)]))
 
 
-def _pad_index(counts: Sequence[int], capacity: int) -> np.ndarray:
+@functools.lru_cache(maxsize=512)
+def _pad_index(counts: Tuple[int, ...], capacity: int) -> np.ndarray:
     """Static index map from the packed sum(counts) layout to the
     (size*capacity) block layout; padding slots re-read a valid element
     (receivers mask them, and the masked cotangent is zero, so the
-    duplicate read neither leaks data nor gradient)."""
+    duplicate read neither leaks data nor gradient).  Memoized like
+    :func:`_pack_index`."""
     offsets = np.concatenate([[0], np.cumsum(counts)])
     total = int(offsets[-1])
     out = []
@@ -90,7 +104,8 @@ def _pad_index(counts: Sequence[int], capacity: int) -> np.ndarray:
         idx = base + np.minimum(np.arange(capacity, dtype=np.int64),
                                 max(c - 1, 0))
         out.append(np.minimum(idx, max(total - 1, 0)))
-    return np.concatenate(out) if out else np.zeros(0, np.int64)
+    return _frozen(np.concatenate(out) if out
+                   else np.zeros(0, np.int64))
 
 
 def packed_gather(comm, x, gatheraxis: int, numelem, root: int):

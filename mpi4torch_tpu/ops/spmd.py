@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import functools
 import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -260,13 +261,25 @@ class PermRank:
         return f"PermRank({self.axis_name!r}, table={self.table})"
 
 
+@functools.lru_cache(maxsize=512)
 def _perm_desc(perm: Tuple[int, ...]) -> str:
-    """Human form of a send permutation for error messages."""
+    """Human form of a send permutation for error messages.  Memoized:
+    region-close checks and every posted p2p op re-describe the same
+    handful of permutations on each traced call."""
     n = len(perm)
     shifts = {(perm[r] - r) % n for r in range(n)}
     if len(shifts) == 1:
         return f"ring shift {next(iter(shifts))}"
     return f"perm {list(perm)}"
+
+
+@functools.lru_cache(maxsize=512)
+def _ring_table(n: int, k: int) -> Tuple[int, ...]:
+    """Send-permutation table of the ring shift ``+k`` on ``n`` ranks.
+    Memoized: every Isend/Irecv of a ring schedule (and every step of a
+    bucketed pipeline) resolves the same (n, k) to the same tuple —
+    recomputing it per traced call is pure overhead."""
+    return tuple((r + k) % n for r in range(n))
 
 
 def _peer_table(ctx: SpmdContext, peer, what: str) -> Tuple[int, ...]:
@@ -289,8 +302,7 @@ def _peer_table(ctx: SpmdContext, peer, what: str) -> Tuple[int, ...]:
                 f"`(comm.rank {peer.offset:+d}) % comm.size` for a ring "
                 "shift"
             )
-        k = peer.offset % n
-        return tuple((r + k) % n for r in range(n))
+        return _ring_table(n, peer.offset % n)
     if isinstance(peer, PermRank):
         if peer.axis_name != ctx.axis_name or peer.size != n:
             raise CommError(
@@ -1244,29 +1256,31 @@ def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
         mesh = Mesh(np.asarray(devs[:n]), (axis_name,))
     size = mesh.shape[axis_name]
 
-    def wrapped(det, comp, *args):
+    def wrapped(det, comp, bb, *args):
         ctx = SpmdContext(axis_name=axis_name, size=size)
         with _bind_spmd(ctx), _config.deterministic_mode(det), \
-                _config.compression_scope(comp):
+                _config.compression_scope(comp), _config.fusion_scope(bb):
             out = fn(*args)
         return jax.tree.map(lambda y: jnp.expand_dims(y, 0), out)
 
-    def sm(det, comp, *args):
-        return shard_map(lambda *a: wrapped(det, comp, *a), mesh=mesh,
+    def sm(det, comp, bb, *args):
+        return shard_map(lambda *a: wrapped(det, comp, bb, *a), mesh=mesh,
                          in_specs=P(), out_specs=P(axis_name),
                          check_vma=False)(*args)
 
     if jit:
-        jitted = jax.jit(sm, static_argnums=(0, 1))
+        jitted = jax.jit(sm, static_argnums=(0, 1, 2))
     else:
         jitted = sm
 
     def call(*args):
-        # The deterministic-reductions flag and the compression default
-        # are read at *call* time and made part of the jit cache key
-        # (static args), so toggling either after the first call retraces
-        # instead of silently reusing the old lowering.
+        # The deterministic-reductions flag, the compression default and
+        # the fusion bucket size are read at *call* time and made part of
+        # the jit cache key (static args), so toggling any of them after
+        # the first call retraces instead of silently reusing the old
+        # lowering.
         return jitted(_config.deterministic_reductions(),
-                      _config.default_compression(), *args)
+                      _config.default_compression(),
+                      _config.default_bucket_bytes(), *args)
 
     return call

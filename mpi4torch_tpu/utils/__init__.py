@@ -4,8 +4,9 @@ test helpers."""
 from .checkpoint import CheckpointManager, restore_checkpoint, save_checkpoint
 from .data import prefetch_to_device, shard_batches, shard_batches_comm
 from .lbfgs import LBFGS, minimize_lbfgs
-from .profiling import profiler_trace
+from .profiling import bucket_scope, profiler_trace
 
 __all__ = ["LBFGS", "minimize_lbfgs", "CheckpointManager",
            "restore_checkpoint", "save_checkpoint", "profiler_trace",
-           "shard_batches", "shard_batches_comm", "prefetch_to_device"]
+           "bucket_scope", "shard_batches", "shard_batches_comm",
+           "prefetch_to_device"]

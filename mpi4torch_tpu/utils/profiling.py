@@ -25,7 +25,26 @@ from __future__ import annotations
 
 import contextlib
 
-__all__ = ["profiler_trace"]
+__all__ = ["profiler_trace", "bucket_scope"]
+
+
+def bucket_scope(op: str, index: int, total: int, codec=None):
+    """Named scope for one bucket of a fused tree collective
+    (mpi4torch_tpu.fuse): ``mpi4torch.<op>.bucket<i>of<n>[.<codec>]``.
+
+    The fused path replaces hundreds of per-leaf op spans with a few
+    per-bucket ones; these scopes keep the profiler story intact —
+    every transfer in a trace is attributable to a specific bucket, and
+    compressed buckets carry the codec suffix exactly like the facade's
+    single-tensor ops (``mpi4torch.Allreduce.q8``).  Nested inside the
+    facade's own per-op scope, so a fused q8 bucket shows as
+    ``mpi4torch.Allreduce_tree.bucket0of3.q8/mpi4torch.Allreduce.q8``."""
+    import jax
+
+    name = f"mpi4torch.{op}.bucket{index}of{total}"
+    if codec is not None:
+        name += f".{codec.name}"
+    return jax.named_scope(name)
 
 
 @contextlib.contextmanager
