@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -25,6 +25,15 @@ tier1:
 		| tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
 		| tr -cd . | wc -c); exit $$rc
+
+# CPU smoke run of the allreduce-algorithm autotuner sweep
+# (mpi4torch_tpu.tune): measures ring/rhd/tree/hier at three small
+# sizes, persists winners to the JSON cache, prints the report.  Run it
+# twice to see `"tuned_from_cache": true` on the second pass.
+tune-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.tune.autotuner --smoke
 
 # Hardware-gated subset: requires a real TPU.  The escape hatch opens the
 # conftest platform gate (which otherwise pins cpu, regardless of any

@@ -403,6 +403,73 @@ def _bench_allreduce_fused(on_tpu: bool):
     return out
 
 
+def _bench_allreduce_algorithms(on_tpu: bool):
+    """Per-algorithm allreduce size sweep (mpi4torch_tpu.tune, ISSUE 3):
+    1 KiB → 64 MiB on hardware (three points on the CPU smoke path),
+    per-algorithm GB/s under ring-allreduce wire accounting, the
+    measured ring/latency crossover, and the persistent autotuner's
+    picks.  The autotuner stanza round-trips its JSON cache: the first
+    bench run measures and persists, a second run reports
+    ``tuned_from_cache: true`` with the same picks and zero tuning
+    overhead — the ISSUE 3 acceptance evidence."""
+    import jax
+
+    from mpi4torch_tpu import tune
+
+    import jax.numpy as jnp
+
+    from mpi4torch_tpu.tune.autotuner import DEFAULT_SIZES, SMOKE_SIZES
+
+    n = len(jax.devices())
+    # The autotuner's own sweep grids: the cache keys this stanza
+    # probes/persists MUST be the ones ensure_tuned/`make tune-smoke`
+    # use, or tuned_from_cache goes permanently false on a grid drift.
+    sizes = DEFAULT_SIZES if on_tpu else SMOKE_SIZES
+    iters = 20 if on_tpu else 3
+
+    # Cache state BEFORE this run's sweep overwrites it: a prior bench
+    # run's persisted winners covering every size are the
+    # `tuned_from_cache` evidence (a steady-state process would select
+    # tuned algorithms with zero measurement).
+    def _had_disk():
+        return all(
+            tune.lookup("allreduce", jnp.float32, s, n) is not None
+            and tune.entry_from_disk("allreduce", jnp.float32, s, n)
+            for s in sizes)
+
+    had_disk = _guarded("allreduce_algorithms.cache_probe", _had_disk)
+
+    # ONE sweep implementation: the autotuner's own (per-algorithm
+    # seconds + ring-wire GB/s + winner + crossover, with per-candidate
+    # error stanzas inside) — the bench must never fork its own copy of
+    # the measurement/crossover rules.  This pass IS the tuning run:
+    # winners persist to the JSON cache and the measured crossover is
+    # applied, so the next process (and the next bench run) selects
+    # tuned algorithms without measuring.
+    rep = tune.autotune_allreduce(sizes=sizes, nranks=n, iters=iters)
+    out = {
+        "n_devices": n,
+        "dtype": rep["dtype"],
+        "sizes": rep["entries"],
+        # The crossover table's headline: the largest size where a
+        # latency-optimal schedule still beats the ring (None = ring
+        # wins everywhere measured — the latency regime not reached).
+        "crossover_bytes": rep["crossover_bytes"],
+        "autotuner": {
+            "tuned_from_cache": bool(had_disk is True),
+            "cache_file": rep["cache_file"],
+            "crossover_bytes": rep["crossover_bytes"],
+            "picks": {k: v.get("winner")
+                      for k, v in rep["entries"].items()},
+        },
+    }
+    if n == 1:
+        out["note"] = ("single device: no wire; per-algorithm timings "
+                       "price schedule arithmetic only — the crossover "
+                       "is meaningful where ICI/DCN is in the path")
+    return out
+
+
 def _bench_flash(on_tpu: bool, peak: float):
     """Causal flash-attention fwd+bwd achieved FLOP/s and MFU."""
     import jax
@@ -856,6 +923,8 @@ def main() -> None:
         arc = _guarded("allreduce_compressed", _bench_allreduce_compressed,
                        on_tpu)
         arf = _guarded("allreduce_fused", _bench_allreduce_fused, on_tpu)
+        ara = _guarded("allreduce_algorithms", _bench_allreduce_algorithms,
+                       on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -886,6 +955,7 @@ def main() -> None:
             "allreduce": ar,
             "allreduce_compressed": arc,
             "allreduce_fused": arf,
+            "allreduce_algorithms": ara,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

@@ -4,7 +4,7 @@ Three code comments in ``ops/spmd.py`` argue trade-offs from HLO text
 (round-3 verdict: argued, never timed); this harness times them so the
 comments can carry measured numbers:
 
-1. **Bcast_ tree/psum crossover** (`spmd.py` `_BCAST_TREE_MAX_BYTES`):
+1. **Bcast_ tree/psum crossover** (`config.bcast_tree_max_bytes`):
    sweep tensor sizes across the 256 KiB threshold, timing the
    binomial-tree lowering vs the masked-psum lowering head-to-head.
 2. **Gather all-gather-then-mask cost**: Gather-to-root vs plain
@@ -59,14 +59,14 @@ def bench_bcast_crossover(n):
         x = jnp.ones((nelem,), jnp.float32)
         point = {"bytes": nelem * 4}
         for mode, max_bytes in (("tree", 1 << 62), ("psum", 0)):
-            saved = spmd._BCAST_TREE_MAX_BYTES
-            spmd._BCAST_TREE_MAX_BYTES = max_bytes
+            saved = mpi.config.bcast_tree_max_bytes()
+            mpi.config.set_bcast_tree_max_bytes(max_bytes)
             try:
                 step = mpi.run_spmd(
                     lambda x: mpi.COMM_WORLD.Bcast_(x, 0), nranks=n)
                 point[f"{mode}_s"] = _timeit(step, x, iters=10)
             finally:
-                spmd._BCAST_TREE_MAX_BYTES = saved
+                mpi.config.set_bcast_tree_max_bytes(saved)
             _note(f"bcast {point['bytes']}B {mode}: {point[f'{mode}_s']:.2e}s")
         point["tree_faster"] = point["tree_s"] < point["psum_s"]
         results.append(point)
@@ -131,7 +131,8 @@ def bench_deterministic_overhead(n):
 def bench_ordered_fold_paths(n):
     """Gather-fold vs chunked-ring-fold deterministic Allreduce (VERDICT r4
     item 3): both are bit-identical; this measures the memory/latency trade
-    to calibrate ``_ORDERED_FOLD_GATHER_MAX_BYTES``.  Native psum is the
+    to calibrate ``config.ordered_fold_gather_max_bytes``.  Native psum is
+    the
     speed-of-light reference at each size."""
     import jax.numpy as jnp
 
@@ -148,18 +149,18 @@ def bench_ordered_fold_paths(n):
             lambda x: mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM), nranks=n)
         point["psum_s"] = _timeit(step, x, iters=10)
         saved_det = config.deterministic_reductions()
-        saved_thresh = spmd._ORDERED_FOLD_GATHER_MAX_BYTES
+        saved_thresh = config.ordered_fold_gather_max_bytes()
         config.set_deterministic_reductions(True)
         try:
             for mode, thresh in (("gather_fold", 1 << 62), ("ring_fold", 0)):
-                spmd._ORDERED_FOLD_GATHER_MAX_BYTES = thresh
+                config.set_ordered_fold_gather_max_bytes(thresh)
                 step = mpi.run_spmd(
                     lambda x: mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM),
                     nranks=n)
                 point[f"{mode}_s"] = _timeit(step, x, iters=10)
         finally:
             config.set_deterministic_reductions(saved_det)
-            spmd._ORDERED_FOLD_GATHER_MAX_BYTES = saved_thresh
+            config.set_ordered_fold_gather_max_bytes(saved_thresh)
         point["ring_vs_gather"] = point["ring_fold_s"] / point["gather_fold_s"]
         _note(f"ordered fold {point['bytes']}B: gather "
               f"{point['gather_fold_s']:.2e}s ring {point['ring_fold_s']:.2e}s "

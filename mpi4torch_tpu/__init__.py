@@ -62,7 +62,8 @@ from .distributed import (
 from . import config
 from . import compress
 from . import fuse
-from .config import compression_scope, fusion_scope
+from . import tune
+from .config import algorithm_scope, compression_scope, fusion_scope
 
 __all__ = [
     # reference __all__ (src/__init__.py:5-25)
@@ -103,6 +104,8 @@ __all__ = [
     "config",
     "compress",
     "fuse",
+    "tune",
+    "algorithm_scope",
     "compression_scope",
     "fusion_scope",
     "CommError",

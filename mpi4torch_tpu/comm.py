@@ -107,6 +107,49 @@ def _resolve_compression(compression):
     return get_codec(compression)
 
 
+def _resolve_algorithm(algorithm, nranks, collective="allreduce"):
+    """Resolve a facade ``algorithm=`` argument (mpi4torch_tpu.tune).
+
+    ``None`` defers to the scope/process default
+    (config.default_algorithm / config.algorithm_scope), which in turn
+    defers to the tune selector when unset.  Returns a concrete
+    algorithm name or None (selector-driven auto).  Explicit requests
+    that cannot serve the call raise; scope defaults degrade to
+    ``ring`` — the compress degrade/raise rule."""
+    from . import config as _cfg
+    from . import tune as _tune
+
+    explicit = algorithm is not None
+    requested = algorithm if explicit else _cfg.default_algorithm()
+    return _tune.resolve_request(requested, collective=collective,
+                                 nranks=nranks, explicit=explicit)
+
+
+def _reconcile_codec_algorithm(codec, algo, codec_explicit: bool,
+                               algo_explicit: bool):
+    """Resolve a codec/algorithm pairing that does not compose
+    (``Codec.algorithms``; every shipped codec is ring-only).  Both
+    halves explicit → raise; otherwise the scope-provided half yields
+    (explicit algorithm → exact wire; explicit/scope codec → ring).
+    One shared rule for the per-tensor facade and the fused per-bucket
+    path, with one exception type."""
+    if codec is None or algo in (None, "ring"):
+        return codec, algo
+    from .tune import codec_algorithms
+
+    if algo in codec_algorithms(codec):
+        return codec, algo
+    if codec_explicit and algo_explicit:
+        raise ValueError(
+            f"compression={codec.name!r} composes with the "
+            f"{'/'.join(codec_algorithms(codec))} wire algorithm(s) "
+            f"only; algorithm={algo!r} cannot carry this codec — drop "
+            "one of the two")
+    if algo_explicit:
+        return None, algo      # explicit algorithm; scope codec yields
+    return codec, "ring"       # explicit/scope codec; algorithm yields
+
+
 def _codec_for(tensor, codec, explicit):
     """Float tensors only: quantization of integer/bool payloads (counts,
     masks, descriptors) would silently truncate.  A scope-level default
@@ -196,7 +239,8 @@ class MPI_Communicator:
 
     # ----------------------------------------------------------- collectives
 
-    def Allreduce(self, tensor, op: int, compression=None):
+    def Allreduce(self, tensor, op: int, compression=None,
+                  algorithm=None):
         """Element-wise combine across all ranks, result on every rank
         (reference: src/__init__.py:125-152, csrc/extension.cpp:274-308).
         Only ``MPI_SUM`` is differentiable; other ops raise in backward.
@@ -207,7 +251,22 @@ class MPI_Communicator:
         Allreduce is MPI_SUM-only and stays AD-transparent: its backward is
         itself a compressed Allreduce.  The named scope gains the codec
         suffix (``mpi4torch.Allreduce.q8``) so profiler traces distinguish
-        compressed transfers."""
+        compressed transfers.
+
+        ``algorithm`` selects the wire schedule
+        (:mod:`mpi4torch_tpu.tune`: ``"ring"``, ``"rhd"``, ``"tree"``,
+        ``"hier"``, or ``False``/``"auto"`` to override an active
+        ``algorithm_scope``); ``None`` defers to the scope/process
+        default, which defers to the autotuner-backed selector.  The
+        backward pass uses the matching algorithm.  Codecs declare
+        which algorithms they compose with (``q8`` is ring-only): an
+        explicit algorithm + explicit codec that do not compose raise;
+        with only one of them explicit, the scope-provided half
+        degrades (explicit algorithm → exact wire; explicit codec →
+        ring)."""
+        if algorithm is False:
+            algorithm = "auto"
+        algo_explicit = algorithm not in (None, "auto")
         codec = _codec_for(tensor, _resolve_compression(compression),
                            explicit=compression is not None)
         if codec is not None and op != C.MPI_SUM and compression is None:
@@ -217,15 +276,53 @@ class MPI_Communicator:
             # for compression.  An explicit compression= still raises in
             # the backend.
             codec = None
+        backend = self._backend()
+        if getattr(backend, "owns_algorithm_resolution", False):
+            # The 2-axis hier backend keys its tiers off the mesh axes
+            # themselves, so the registry's flat-world applicability
+            # gates (power-of-two, group factorization of the rank
+            # PRODUCT) do not apply — validate the name only and let
+            # the backend enforce what it can lower (explicit raises,
+            # scope defaults yield to its native schedule).
+            from . import config as _cfg
+            from .tune import get_algorithm
+            # False/"auto" force selector-driven choice (here: the
+            # backend's native schedule) even inside an algorithm_scope
+            # — same override semantics as the single-axis path.
+            requested = (algorithm if algo_explicit
+                         else None if algorithm == "auto"
+                         else _cfg.default_algorithm())
+            algo = (None if requested in (None, "auto")
+                    else get_algorithm(requested).name)
+        else:
+            algo = _resolve_algorithm(algorithm, backend.size)
+        codec, algo = _reconcile_codec_algorithm(
+            codec, algo, codec_explicit=compression is not None,
+            algo_explicit=algo_explicit)
+        if codec is not None and not getattr(backend,
+                                             "supports_compression", True):
+            # Backends without a compressed pipeline (the 2-axis hier
+            # communicator): an explicit codec raises, a scope default
+            # degrades to the exact wire — the standard rule.
+            if compression is not None:
+                raise ValueError(
+                    f"compression={codec.name!r} is not supported on "
+                    "this communicator (the 2-axis hierarchical "
+                    "backend has no compressed pipeline); use a "
+                    "single-axis comm_from_mesh communicator")
+            codec = None
         scope = "mpi4torch.Allreduce" + (f".{codec.name}" if codec else "")
+        if codec is None and algo not in (None, "ring"):
+            scope += f".{algo}"
         with jax.named_scope(scope):
             if codec is None:
-                return self._backend().allreduce(tensor, op)
-            return self._backend().allreduce_compressed(tensor, op, codec)
+                return backend.allreduce(tensor, op, algorithm=algo,
+                                         algorithm_explicit=algo_explicit)
+            return backend.allreduce_compressed(tensor, op, codec)
 
     def Allreduce_tree(self, tree, op: int, compression=None,
                        bucket_bytes=None, mean: bool = False,
-                       overlap=None):
+                       overlap=None, algorithm=None):
         """Fused bucketed Allreduce over a whole pytree
         (:mod:`mpi4torch_tpu.fuse`): the leaves are flattened into
         dtype-homogeneous flat buckets of ~``bucket_bytes`` (layout
@@ -244,24 +341,43 @@ class MPI_Communicator:
         scale (MPI_SUM only).  ``compression`` follows the
         :meth:`Allreduce` contract, applied per bucket.  ``overlap``
         picks the scheduler (None = backend default; see
-        :func:`mpi4torch_tpu.fuse.fused_allreduce_tree`)."""
+        :func:`mpi4torch_tpu.fuse.fused_allreduce_tree`).
+        ``algorithm`` follows the :meth:`Allreduce` contract, applied
+        *per bucket*: with auto selection, small tail buckets take the
+        latency algorithm where the autotuner's measurements say so."""
         from .fuse import fused_allreduce_tree
         with jax.named_scope("mpi4torch.Allreduce_tree"):
             return fused_allreduce_tree(
                 self, tree, op, compression=compression,
-                bucket_bytes=bucket_bytes, mean=mean, overlap=overlap)
+                bucket_bytes=bucket_bytes, mean=mean, overlap=overlap,
+                algorithm=algorithm)
 
     @_named_op
-    def Bcast_(self, tensor, root: int):
-        """Broadcast from ``root`` (reference: src/__init__.py:154-175)."""
-        return self._backend().bcast_(tensor, root)
+    def Bcast_(self, tensor, root: int, algorithm=None):
+        """Broadcast from ``root`` (reference: src/__init__.py:154-175).
+
+        ``algorithm`` (:mod:`mpi4torch_tpu.tune`): ``"tree"`` pins the
+        binomial-tree lowering, ``"ring"`` the root-masked psum;
+        ``None`` keeps the size dispatch
+        (``config.bcast_tree_max_bytes``).  The adjoint (a Reduce_)
+        uses the matching algorithm."""
+        algo = _resolve_algorithm(algorithm, self.size,
+                                  collective="bcast")
+        return self._backend().bcast_(tensor, root, algorithm=algo)
 
     @_named_op
-    def Reduce_(self, tensor, op: int, root: int):
+    def Reduce_(self, tensor, op: int, root: int, algorithm=None):
         """Reduce to ``root``; non-root results are zeroed and the input is
         consumed (reference: src/__init__.py:177-210,
-        csrc/extension.cpp:405-464)."""
-        return self._backend().reduce_(tensor, op, root)
+        csrc/extension.cpp:405-464).
+
+        ``algorithm`` (:mod:`mpi4torch_tpu.tune`): ``"tree"`` pins the
+        binomial reduce-to-root (``ceil(log2 N)`` permute hops);
+        ``"ring"``/``None`` the masked-allreduce form.  The adjoint (a
+        Bcast_) uses the matching algorithm."""
+        algo = _resolve_algorithm(algorithm, self.size,
+                                  collective="reduce")
+        return self._backend().reduce_(tensor, op, root, algorithm=algo)
 
     @_named_op
     def Gather(self, tensor, gatheraxis: int, root: int, numelem=None):
@@ -416,8 +532,9 @@ class _EagerBackend:
     def size(self) -> int:
         return self._ctx.world.size
 
-    def allreduce(self, x, op):
-        return _eager.allreduce(self._ctx, x, op)
+    def allreduce(self, x, op, algorithm=None, algorithm_explicit=False):
+        return _eager.allreduce(self._ctx, x, op, algorithm=algorithm,
+                                algorithm_explicit=algorithm_explicit)
 
     def allreduce_compressed(self, x, op, codec):
         from .compress import eager as _ceager
@@ -427,11 +544,12 @@ class _EagerBackend:
         from .compress import eager as _ceager
         return _ceager.allgather(self._ctx, x, gatheraxis, codec)
 
-    def bcast_(self, x, root):
-        return _eager.bcast_(self._ctx, x, root)
+    def bcast_(self, x, root, algorithm=None):
+        return _eager.bcast_(self._ctx, x, root, algorithm=algorithm)
 
-    def reduce_(self, x, op, root):
-        return _eager.reduce_(self._ctx, x, op, root)
+    def reduce_(self, x, op, root, algorithm=None):
+        return _eager.reduce_(self._ctx, x, op, root,
+                              algorithm=algorithm)
 
     def gather(self, x, gatheraxis, root):
         return _eager.gather(self._ctx, x, gatheraxis, root)

@@ -41,19 +41,33 @@ from .codecs import (BF16Codec, BF16StochasticCodec, BlockQ8Codec, Codec,
 from .ef import ef_allreduce, ef_init
 
 
-def codec_applicable(codec, dtype) -> bool:
-    """True when ``codec`` may legally touch a tensor of ``dtype``.
+def codec_applicable(codec, dtype, algorithm=None) -> bool:
+    """True when ``codec`` may legally touch a tensor of ``dtype`` (and,
+    when ``algorithm`` is given, ride that wire algorithm).
 
     Quantizing integer/bool payloads (counts, masks, descriptors) would
     silently truncate rather than approximate, so only floating tensors
     are compressible.  This is THE dtype gate — the facade applies it
     per tensor (comm.py ``_codec_for``) and the fused bucketed
     collectives per dtype-homogeneous bucket (fuse/collectives.py), so
-    the degrade/raise behavior cannot drift between the two paths."""
+    the degrade/raise behavior cannot drift between the two paths.
+
+    The ``algorithm`` leg consults the codec's own declaration
+    (``Codec.algorithms``; ring-only for every shipped codec — the
+    quantized pipeline is a ring): the tune selector respects it when
+    auto-choosing an algorithm under an active compression scope, and
+    the fused per-bucket picker uses it to keep compressed buckets on
+    the ring while exact tail buckets take the latency algorithm."""
     import jax.numpy as jnp
 
-    return codec is not None and jnp.issubdtype(jnp.dtype(dtype),
-                                                jnp.floating)
+    if codec is None or not jnp.issubdtype(jnp.dtype(dtype),
+                                           jnp.floating):
+        return False
+    if algorithm is not None and algorithm != "ring":
+        from ..tune import codec_algorithms
+
+        return algorithm in codec_algorithms(codec)
+    return True
 
 
 __all__ = [

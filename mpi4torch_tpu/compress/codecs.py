@@ -60,11 +60,20 @@ class Codec:
     consume a PRNG key per encode; the collectives derive per-rank,
     per-hop keys so rounding noise is independent across contributions
     (correlated noise would bias the sum).
+
+    ``algorithms`` declares which collective wire algorithms
+    (:mod:`mpi4torch_tpu.tune`) the codec composes with.  Every shipped
+    codec is ``("ring",)``: the compressed pipeline re-quantizes the
+    partial sum at each ring hop (compress/spmd.py), a structure the
+    butterfly/tree/hierarchical schedules do not share — the tune
+    selector restricts auto choice to these algorithms, and explicit
+    mismatched requests raise at the facade (comm.Allreduce).
     """
 
     name: str
     stochastic: bool = False
     ef_rounds: int = 1
+    algorithms: Tuple[str, ...] = ("ring",)
 
     def base(self) -> "Codec":
         """The single-round codec used for each error-feedback round."""

@@ -147,6 +147,194 @@ def fusion_scope(bucket_bytes):
             _state.bucket_bytes = prev
 
 
+# ---------------------------------------------------------------------------
+# Collective-algorithm selection (mpi4torch_tpu.tune)
+# ---------------------------------------------------------------------------
+
+_process_algorithm = None
+
+
+def default_algorithm():
+    """The collective algorithm facade ops use when no explicit
+    ``algorithm=`` is passed: the innermost active :func:`algorithm_scope`
+    on this thread, else the process-wide :func:`set_default_algorithm`
+    value.  ``None``/``"auto"`` defer to the :mod:`mpi4torch_tpu.tune`
+    selector (measured cache winner where one exists, else ``ring``)."""
+    scoped = getattr(_state, "algorithm", _UNSET)
+    return _process_algorithm if scoped is _UNSET else scoped
+
+
+def _validated_algorithm(name):
+    if name is None or name == "auto":
+        return None
+    from .tune import get_algorithm
+
+    return get_algorithm(name).name  # raises on unknown names
+
+
+def set_default_algorithm(name) -> None:
+    """Set the process-wide default collective algorithm (a registered
+    algorithm name — ``ring``/``rhd``/``tree``/``hier`` — or
+    ``None``/``"auto"`` for selector-driven choice).  A scope/process
+    default is a *preference*: collectives it cannot legally serve
+    (e.g. ``rhd`` on a non-power-of-two world, or a compressed transfer
+    whose codec is ring-only) silently fall back to auto selection,
+    exactly like the compression scope's degrade rule; an explicit
+    per-call ``algorithm=`` raises instead."""
+    global _process_algorithm
+    _process_algorithm = _validated_algorithm(name)
+
+
+@contextmanager
+def algorithm_scope(name):
+    """Lexically scoped collective-algorithm default::
+
+        with mpi.config.algorithm_scope("rhd"):
+            y = comm.Allreduce(x, mpi.MPI_SUM)   # latency-optimal wire
+
+    Per-thread like :func:`compression_scope`; ``run_spmd`` re-reads the
+    value at call time and makes it part of its jit cache key, so
+    toggling retraces."""
+    prev = getattr(_state, "algorithm", _UNSET)
+    _state.algorithm = _validated_algorithm(name)
+    try:
+        yield
+    finally:
+        if prev is _UNSET:
+            del _state.algorithm
+        else:
+            _state.algorithm = prev
+
+
+# ---------------------------------------------------------------------------
+# Collective schedule thresholds (promoted from ops/spmd.py constants;
+# ISSUE 3 satellite).  Process-wide, validated, and overridable from
+# measurement by the mpi4torch_tpu.tune autotuner.
+# ---------------------------------------------------------------------------
+
+# The all-gather+fold form of the ordered reduction materializes size× the
+# tensor per rank; below this many *gathered* bytes (payload × ranks) its
+# latency advantage wins.  Above it, the chunked ring fold caps peak extra
+# memory at ≈2× the tensor.  Both paths are bit-identical, so the switch
+# is safe at any value.
+DEFAULT_ORDERED_FOLD_GATHER_MAX_BYTES = 4 * 1024 * 1024
+# Pipeline granularity of the deterministic ring fold.
+DEFAULT_ORDERED_RING_CHUNK_BYTES = 8 * 1024 * 1024
+# Payloads at or below this take the binomial-tree broadcast (log2(N)
+# sequential full-payload hops); larger ones the root-masked psum (see
+# ops/spmd.py _bcast_value for the wire accounting).
+DEFAULT_BCAST_TREE_MAX_BYTES = 256 * 1024
+
+_ordered_fold_gather_max_bytes = DEFAULT_ORDERED_FOLD_GATHER_MAX_BYTES
+_ordered_ring_chunk_bytes = DEFAULT_ORDERED_RING_CHUNK_BYTES
+_bcast_tree_max_bytes = DEFAULT_BCAST_TREE_MAX_BYTES
+
+
+def _validated_threshold(nbytes, what: str, minimum: int = 0) -> int:
+    try:
+        nbytes = int(nbytes)
+    except (TypeError, ValueError):
+        raise ValueError(f"{what} must be an integer byte count, got "
+                         f"{nbytes!r}") from None
+    if nbytes < minimum:
+        raise ValueError(f"{what} must be >= {minimum}, got {nbytes}")
+    return nbytes
+
+
+def ordered_fold_gather_max_bytes() -> int:
+    """Gathered-bytes ceiling (payload × ranks) below which the
+    deterministic ordered fold uses the all-gather+fold form instead of
+    the chunked ring (ops/spmd.py)."""
+    return _ordered_fold_gather_max_bytes
+
+
+def set_ordered_fold_gather_max_bytes(nbytes) -> None:
+    global _ordered_fold_gather_max_bytes
+    _ordered_fold_gather_max_bytes = _validated_threshold(
+        nbytes, "ordered_fold_gather_max_bytes")
+
+
+def ordered_ring_chunk_bytes() -> int:
+    """Chunk size of the deterministic ring-fold pipeline
+    (ops/spmd.py)."""
+    return _ordered_ring_chunk_bytes
+
+
+def set_ordered_ring_chunk_bytes(nbytes) -> None:
+    global _ordered_ring_chunk_bytes
+    _ordered_ring_chunk_bytes = _validated_threshold(
+        nbytes, "ordered_ring_chunk_bytes", minimum=1)
+
+
+def bcast_tree_max_bytes() -> int:
+    """Payload-bytes ceiling below which ``Bcast_`` takes the
+    binomial-tree lowering instead of the root-masked psum
+    (ops/spmd.py)."""
+    return _bcast_tree_max_bytes
+
+
+def set_bcast_tree_max_bytes(nbytes) -> None:
+    global _bcast_tree_max_bytes
+    _bcast_tree_max_bytes = _validated_threshold(
+        nbytes, "bcast_tree_max_bytes")
+
+
+# Measured latency/bandwidth crossover for allreduce algorithm selection.
+# None = not measured: the selector never switches algorithms on a
+# heuristic alone — it deviates from `ring` only on evidence (a cached
+# per-key winner, or this crossover once the autotuner has measured it).
+_latency_crossover_bytes = None
+
+
+def latency_crossover_bytes():
+    """Payload-bytes ceiling below which the tune selector prefers a
+    latency-optimal algorithm (``rhd``, else ``tree``) for auto-selected
+    allreduces.  ``None`` (default) = unmeasured: auto-selection stays
+    on ``ring`` except where the autotuner cache names a winner.  Set
+    from measurement by :func:`mpi4torch_tpu.tune.autotune_allreduce`
+    or explicitly here."""
+    return _latency_crossover_bytes
+
+
+def set_latency_crossover_bytes(nbytes) -> None:
+    global _latency_crossover_bytes
+    _latency_crossover_bytes = (
+        None if nbytes is None
+        else _validated_threshold(nbytes, "latency_crossover_bytes"))
+
+
+# Intra-group size of the 2-level `hier` allreduce on a single mesh axis.
+# None = derive: the minor axis extent when the communicator was adopted
+# from a multi-axis mesh, else the divisor of nranks closest to sqrt.
+_hier_group_size = None
+
+
+def hier_group_size():
+    """Intra-group size of the single-axis ``hier`` allreduce (must
+    divide the communicator size, 1 < g < size).  ``None`` = derive from
+    topology (see :mod:`mpi4torch_tpu.tune`)."""
+    return _hier_group_size
+
+
+def set_hier_group_size(g) -> None:
+    global _hier_group_size
+    if g is None:
+        _hier_group_size = None
+        return
+    g = _validated_threshold(g, "hier_group_size", minimum=2)
+    _hier_group_size = g
+
+
+def thresholds_fingerprint():
+    """Hashable snapshot of every trace-time threshold/selection knob —
+    ``run_spmd`` folds it into its jit cache key so overriding a
+    threshold (or the autotuner writing a measured crossover) retraces
+    instead of silently reusing the old lowering."""
+    return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
+            _bcast_tree_max_bytes, _latency_crossover_bytes,
+            _hier_group_size)
+
+
 @contextmanager
 def compression_scope(codec):
     """Lexically scoped compression default::

@@ -133,6 +133,73 @@ def combine2(op: int, a, b):
     raise ValueError(f"Unknown reduction op code {op}")
 
 
+def reduce_rhd(op, values):
+    """Reduce per-rank tensors in the recursive-halving/doubling
+    association: a balanced binary tree pairing rank ``i`` with rank
+    ``i + h`` at halving distance ``h = n/2, n/4, ..., 1``.
+
+    This is exactly the association the SPMD ``rhd`` schedule
+    (ops/spmd.py ``_rhd_allreduce_value``) produces on the wire, so the
+    eager rendezvous backend folding with this helper is bit-identical
+    to the compiled butterfly — the Mode A / Mode B parity contract per
+    algorithm (all MPI fold ops are commutative, so only the
+    association — which this fixes — affects bits).  Requires a
+    power-of-two count, like the schedule itself."""
+    vals = list(values)
+    n = len(vals)
+    if n & (n - 1):
+        raise ValueError(
+            f"reduce_rhd needs a power-of-two rank count, got {n}")
+    while n > 1:
+        h = n // 2
+        vals = [combine2(op, vals[i], vals[i + h]) for i in range(h)]
+        n = h
+    return vals[0]
+
+
+def reduce_tree(op, values):
+    """Reduce per-rank tensors in the binomial-tree-toward-rank-0
+    association: at step ``s = 2^(k-1), ..., 2, 1`` every rank
+    ``r < s`` with ``r + s < n`` absorbs rank ``r + s``'s partial.
+
+    Matches the SPMD ``tree`` schedule (ops/spmd.py
+    ``_tree_reduce_value`` with root relabeled to position 0), so eager
+    rendezvous results are bit-identical to the compiled tree — and,
+    unlike :func:`reduce_rhd`, it is defined for any rank count."""
+    vals = list(values)
+    n = len(vals)
+    step = 1
+    while step < n:
+        step *= 2
+    step //= 2
+    while step >= 1:
+        for r in range(step):
+            if r + step < n:
+                vals[r] = combine2(op, vals[r], vals[r + step])
+        step //= 2
+    return vals[0] if vals else None
+
+
+def reduce_grouped(op, values, group: int):
+    """Reduce per-rank tensors in the hierarchical 2-level association:
+    ascending fold within each block of ``group`` consecutive ranks,
+    then ascending fold of the per-group partials.
+
+    Matches the deterministic form of the SPMD ``hier`` schedule
+    (ops/spmd.py ``_hier_allreduce_value``), where groups are
+    consecutive runs along the axis (the intra-tier of a 2-level
+    topology)."""
+    vals = list(values)
+    n = len(vals)
+    if group < 1 or n % group:
+        raise ValueError(
+            f"reduce_grouped needs group ({group}) to divide the rank "
+            f"count ({n})")
+    partials = [reduce_ordered(op, vals[b:b + group])
+                for b in range(0, n, group)]
+    return reduce_ordered(op, partials)
+
+
 # Below this element count the N-1 jnp folds beat the host round-trip of
 # the native kernel.  Measured (bench_tradeoffs.py native_reduce_crossover,
 # 8 f32 buffers, round-5 single-core host): native/jnp seconds were

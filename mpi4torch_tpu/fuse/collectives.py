@@ -183,7 +183,8 @@ def _pipeline_allreduce(comm, buckets: Sequence, op: int, *,
 def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
                          compression=None, bucket_bytes=None,
                          mean: bool = False,
-                         overlap: Optional[bool] = None):
+                         overlap: Optional[bool] = None,
+                         algorithm=None):
     """Allreduce every leaf of ``tree`` through dtype-homogeneous flat
     buckets — one collective (pair) per bucket instead of per leaf.
 
@@ -197,7 +198,17 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     ``True`` under the eager runtime switches to the nonblocking
     Isend/Irecv pipeline (:func:`_pipeline_allreduce`) — exact MPI_SUM
     only; requesting it with a codec or another reduction raises rather
-    than silently degrading to the blocking rendezvous."""
+    than silently degrading to the blocking rendezvous.
+
+    ``algorithm`` follows the facade's Allreduce contract
+    (:mod:`mpi4torch_tpu.tune`), applied *per bucket*: an explicit name
+    pins every bucket; with auto selection the tune selector picks per
+    bucket size, so the full body buckets keep the ring
+    reduce-scatter/all-gather pair while a small tail bucket — below
+    the measured latency crossover — takes the latency-optimal
+    schedule (``rhd``/``tree``) instead of paying O(nranks) ring steps
+    for a few KiB.  Compressed buckets stay on the algorithms their
+    codec declares (``q8`` → ring)."""
     if mean and op != C.MPI_SUM:
         raise CommError(
             f"mean=True is the rank-mean of an MPI_SUM reduction; got "
@@ -206,8 +217,25 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     size = comm.size
     mode_a = _is_mode_a(comm)
     explicit = compression is not None
-    from ..comm import _resolve_compression
+    from ..comm import _resolve_algorithm, _resolve_compression
     codec = _resolve_compression(compression)
+    algo_explicit = algorithm not in (None, False, "auto")
+    owns_resolution = getattr(comm._backend(),
+                              "owns_algorithm_resolution", False)
+    if owns_resolution:
+        # 2-axis hier backend: skip the flat-world registry gates, same
+        # as comm.Allreduce — validate the name only; the backend
+        # enforces what it can lower (explicit raises, scope defaults
+        # yield to its native schedule via the per-bucket degrade
+        # below).
+        from ..tune import get_algorithm
+        requested = (algorithm if algo_explicit
+                     else None if algorithm in (False, "auto")
+                     else _config.default_algorithm())
+        algo = (None if requested in (None, "auto")
+                else get_algorithm(requested).name)
+    else:
+        algo = _resolve_algorithm(algorithm, size)
 
     if not mode_a and overlap:
         # Explicit overlap request on the eager backend: the pipeline is
@@ -229,10 +257,19 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
                    "compression_scope/process default") +
                 ") take the per-bucket rendezvous path — pass "
                 "overlap=False, or compression=False to pipeline exact")
+        if algo not in (None, "ring"):
+            raise CommError(
+                "the fused overlap pipeline's gather-fold IS the ring "
+                f"association; algorithm={algo!r}"
+                + ("" if algorithm is not None else " (from the active "
+                   "algorithm_scope/process default)") +
+                " cannot ride it — pass overlap=False for per-bucket "
+                "rendezvous collectives on that algorithm")
 
     if bb <= 0:
         out = jax.tree.map(
-            lambda p: comm.Allreduce(p, op, compression=compression), tree)
+            lambda p: comm.Allreduce(p, op, compression=compression,
+                                     algorithm=algorithm), tree)
         if mean:
             out = jax.tree.map(lambda p: p / size, out)
         return out
@@ -257,8 +294,49 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     stage = []
     for i, b in enumerate(buckets):
         bcodec = _bucket_codec(comm, b, codec, op, explicit)
+        # Per-bucket algorithm pick (the tune selector): an explicit/
+        # scope name was resolved above and pins every bucket; auto
+        # selection keys on THIS bucket's byte size — small tail
+        # buckets take the latency algorithm where the autotuner's
+        # measurements say so, restricted to what the bucket's codec
+        # declares (q8 buckets stay on the ring).  The codec/algorithm
+        # interplay is reconciled PER BUCKET (after the dtype degrade),
+        # exactly like the per-tensor facade: an exact integer bucket
+        # under a compression scope keeps the scope algorithm the
+        # facade would have honored on the bare tensor.
+        from ..comm import _reconcile_codec_algorithm
+        bcodec, balgo = _reconcile_codec_algorithm(
+            bcodec, algo, codec_explicit=explicit,
+            algo_explicit=algo_explicit)
+        if not algo_explicit:
+            # Backend-side applicability the tree-level resolution
+            # cannot see: the facade call below carries the resolved
+            # name as explicit, so apply the scope-default degrade here
+            # — same rule as the bare comm.Allreduce.  On the 2-axis
+            # backend, anything but hier/ring yields to its native
+            # schedule (auto); on a flat axis, a config.hier_group_size
+            # that does not divide THIS communicator degrades hier to
+            # ring.
+            if owns_resolution:
+                if balgo not in (None, "ring", "hier"):
+                    balgo = None
+            elif balgo == "hier":
+                from ..tune import resolve_hier_group
+                try:
+                    resolve_hier_group(size)
+                except CommError:
+                    balgo = "ring"
+        if balgo is None and mode_a:
+            from .. import tune as _tune
+            balgo = _tune.select_auto(
+                collective="allreduce",
+                nbytes=b.size * b.dtype.itemsize, dtype=b.dtype,
+                nranks=size,
+                deterministic=_config.deterministic_reductions(),
+                codec=bcodec)
+        pair_ok = use_pair and balgo in (None, "ring")
         with bucket_scope("Allreduce_tree", i, nb, codec=bcodec):
-            if bcodec is not None or not use_pair:
+            if bcodec is not None or not pair_ok:
                 # Re-resolution guard: the degrade decision was already
                 # made here, so hand the facade the resolved codec, or
                 # False to pin exact (compression=None would re-read the
@@ -266,7 +344,8 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
                 # explicit compression=False — just opted out of).
                 arg = bcodec if bcodec is not None else (
                     False if (codec is not None or explicit) else None)
-                out = comm.Allreduce(b, op, compression=arg)
+                out = comm.Allreduce(b, op, compression=arg,
+                                     algorithm=balgo)
                 stage.append(("whole", i, out, None))
             else:
                 seg = -(-b.size // size)

@@ -86,19 +86,67 @@ def _shape_sig(x) -> Tuple:
 # extra exchange (two barrier waits, ~tens of µs at thread scale).
 _FOLD_ONCE_MIN = 65536
 
-def allreduce(ctx: RankContext, x, op: int):
+
+def _rendezvous_fold(world_size: int, algorithm,
+                     explicit: bool = False):
+    """The rendezvous-side fold for an algorithm request
+    (mpi4torch_tpu.tune): the eager runtime has no wire, so an
+    algorithm here means a reduction *association* — chosen to match
+    the SPMD schedule of the same name exactly, which is what keeps
+    Mode A and Mode B bit-comparable per algorithm under
+    ``deterministic_mode`` (ops/spmd.py docstrings; the associations
+    live in constants.reduce_rhd / reduce_tree / reduce_grouped).
+    Returns ``(name, fold)`` where ``fold(op, vals)`` reduces a
+    per-rank value list.  Applicability failures follow the facade's
+    degrade/raise rule: ``explicit`` requests raise, scope defaults
+    degrade to the ascending-rank fold."""
+    ring = ("ring", C.reduce_ordered)
+    if algorithm in (None, "auto", "ring"):
+        return ring
+    if algorithm == "rhd":
+        if world_size & (world_size - 1):
+            if not explicit:
+                return ring
+            raise CommError(
+                f"the 'rhd' schedule needs a power-of-two world; got "
+                f"{world_size} ranks — use 'tree' or 'ring'")
+        return "rhd", C.reduce_rhd
+    if algorithm == "tree":
+        return "tree", C.reduce_tree
+    if algorithm == "hier":
+        # Shared group rule with the SPMD schedule (tune.
+        # resolve_hier_group) — one validity gate for both backends.
+        from ..tune import resolve_hier_group
+        try:
+            g = resolve_hier_group(world_size)
+        except CommError:
+            if not explicit:
+                return ring
+            raise
+        return "hier", lambda op, vals: C.reduce_grouped(op, vals, g)
+    raise CommError(
+        f"unknown collective algorithm {algorithm!r} for the eager "
+        "backend")
+
+
+def allreduce(ctx: RankContext, x, op: int, algorithm=None,
+              algorithm_explicit: bool = False):
     """Differentiable Allreduce (reference: csrc/extension.cpp:274-308).
 
     Only MPI_SUM has a defined adjoint; other ops raise at *backward* time,
     matching the reference's MPIUnimplementedNode (csrc/extension.cpp:194-202,
-    279-283)."""
+    279-283).  ``algorithm`` selects the reduction association (see
+    :func:`_rendezvous_fold`); the backward folds with the matching
+    association."""
     world, rank = ctx.world, ctx.rank
     world.check_not_consumed(rank, x)
+    algo_name, fold = _rendezvous_fold(world.size, algorithm,
+                                       explicit=algorithm_explicit)
 
     def impl(v):
         _check_concrete(v)
         sig = _shape_sig(v)
-        vals = world.exchange(rank, ("Allreduce", op, sig), v)
+        vals = world.exchange(rank, ("Allreduce", op, algo_name, sig), v)
         va = jnp.asarray(v)
         if va.size >= _FOLD_ONCE_MIN and C.fold_applicable(op, va.dtype):
             # Every rank would compute the IDENTICAL ascending-rank fold;
@@ -112,7 +160,7 @@ def allreduce(ctx: RankContext, x, op: int):
             # floats) must stay on the every-rank path so it raises
             # symmetrically (ADVICE r5, constants.fold_applicable).
             if rank == 0:
-                red = C.reduce_ordered(op, vals)
+                red = fold(op, vals)
                 if (isinstance(red, np.ndarray) and red.flags.writeable
                         and not any(red is x for x in vals)):
                     # The SAME object is handed to every rank thread; a
@@ -124,8 +172,9 @@ def allreduce(ctx: RankContext, x, op: int):
                     red.flags.writeable = False
             else:
                 red = None
-            return world.exchange(rank, ("Allreduce.fold", op, sig), red)[0]
-        return C.reduce_ordered(op, vals)
+            return world.exchange(rank, ("Allreduce.fold", op, algo_name,
+                                         sig), red)[0]
+        return fold(op, vals)
 
     @jax.custom_vjp
     def f(v):
@@ -205,14 +254,36 @@ def reduce_scatter(ctx: RankContext, x, op: int, scatteraxis: int):
     return f(x)
 
 
-def bcast_(ctx: RankContext, x, root: int):
+def _root_fold(algorithm, root: int):
+    """Reduce-to-root association for an algorithm request: ``tree``
+    matches the SPMD binomial reduce — which relabels ranks RELATIVE TO
+    THE ROOT (ops/spmd.py ``_tree_reduce_value``: ``rel = (idx - root)
+    % n``), so the value list must be rotated root-first before
+    ``constants.reduce_tree`` or the associations (and hence the bits)
+    diverge for ``root != 0``.  Anything else is the ascending-rank
+    fold, which the SPMD ring path also applies unrotated.  (Broadcast
+    itself is pure data movement — the algorithm only shapes the
+    adjoint's reduction.)"""
+    if algorithm != "tree":
+        return C.reduce_ordered
+
+    def fold(op, vals):
+        vals = list(vals)
+        return C.reduce_tree(op, vals[root:] + vals[:root])
+
+    return fold
+
+
+def bcast_(ctx: RankContext, x, root: int, algorithm=None):
     """Differentiable broadcast, in-place in the reference
     (csrc/extension.cpp:333-365).  Functionally pure here: returns the root's
     tensor on every rank.  Adjoint: Reduce_(grad, SUM, root)
-    (csrc/extension.cpp:310-331)."""
+    (csrc/extension.cpp:310-331), folding in the association of the
+    requested ``algorithm`` (``tree`` matches the SPMD binomial tree)."""
     world, rank = ctx.world, ctx.rank
     world.check_not_consumed(rank, x)
     _check_root(world, root)
+    fold = _root_fold(algorithm, root)
 
     def impl(v):
         _check_concrete(v)
@@ -226,7 +297,7 @@ def bcast_(ctx: RankContext, x, root: int):
         # entirely instead of computing it and zeroing it (their folds
         # would serialize redundantly on the host's cores).
         if rank == root:
-            return C.reduce_ordered(C.MPI_SUM, vals)
+            return fold(C.MPI_SUM, vals)
         return jnp.zeros_like(g)
 
     @jax.custom_vjp
@@ -237,7 +308,7 @@ def bcast_(ctx: RankContext, x, root: int):
     return f(x)
 
 
-def reduce_(ctx: RankContext, x, op: int, root: int):
+def reduce_(ctx: RankContext, x, op: int, root: int, algorithm=None):
     """Differentiable reduce-to-root (reference: csrc/extension.cpp:405-464).
 
     Matches the reference's observable semantics: the result on non-root
@@ -245,14 +316,19 @@ def reduce_(ctx: RankContext, x, op: int, root: int):
     (csrc/extension.cpp:443-447), and the *input* is marked consumed so later
     communication ops reject it — the analogue of the MPINoInplaceBackward
     reuse guard (csrc/extension.cpp:395-403, 451-462).  Adjoint:
-    Bcast_(grad, root); only MPI_SUM is differentiable."""
+    Bcast_(grad, root); only MPI_SUM is differentiable.  ``algorithm``
+    ``"tree"`` folds in the SPMD binomial-tree association
+    (constants.reduce_tree) so Mode A/Mode B stay bit-comparable."""
     world, rank = ctx.world, ctx.rank
     world.check_not_consumed(rank, x)
     _check_root(world, root)
+    fold = _root_fold(algorithm, root)
 
     def impl(v):
         _check_concrete(v)
-        vals = world.exchange(rank, ("Reduce_", op, root, _shape_sig(v)), v)
+        vals = world.exchange(rank, ("Reduce_", op, root,
+                                     algorithm or "ring",
+                                     _shape_sig(v)), v)
         # Non-root ranks discard the reduction, so they only compute it
         # when the fold itself would raise (unsupported op, or an op the
         # dtype rejects — e.g. MPI_BAND on floats) — keeping the
@@ -260,7 +336,7 @@ def reduce_(ctx: RankContext, x, op: int, root: int):
         # W-1 redundant memory-bound folds otherwise (ADVICE r5: the
         # gate must be dtype-aware, not fold_supported alone).
         if rank == root or not C.fold_applicable(op, jnp.asarray(v).dtype):
-            red = C.reduce_ordered(op, vals)
+            red = fold(op, vals)
             return red if rank == root else jnp.zeros_like(red)
         return jnp.zeros_like(v)
 

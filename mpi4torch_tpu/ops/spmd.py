@@ -347,17 +347,14 @@ def _identity_perm(n: int) -> Tuple[int, ...]:
 # ---------------------------------------------------------------------------
 
 
-# The all-gather+fold form of the ordered reduction materializes size× the
-# tensor per rank; below this many *gathered* bytes (payload × ranks) its
-# latency advantage wins.  Above it, the chunked ring fold caps peak extra
-# memory at ≈2× the tensor — rank-count-independent — so deterministic
-# mode works at the 1B-param north-star scale (VERDICT r4 weak 2).  Both
-# paths are bit-identical, so the switch is safe at any value;
-# bench_tradeoffs.py measures the real crossover on attached hardware.
-_ORDERED_FOLD_GATHER_MAX_BYTES = 4 * 1024 * 1024
-# Pipeline granularity of the ring fold: per-link wire overhead is
-# (ranks-1)/nchunks of the payload, per-step latency is one chunk hop.
-_ORDERED_RING_CHUNK_BYTES = 8 * 1024 * 1024
+# Schedule thresholds live in config.py (promoted from module constants
+# here, ISSUE 3 satellite): config.ordered_fold_gather_max_bytes() gates
+# the all-gather+fold vs chunked-ring form of the deterministic ordered
+# reduction, config.ordered_ring_chunk_bytes() sets the ring-fold
+# pipeline granularity, config.bcast_tree_max_bytes() the Bcast_ tree/
+# psum dispatch.  All three are validated setters that the tune
+# autotuner can override from measurement (bench_tradeoffs.py measures
+# the real crossovers on attached hardware).
 
 
 def _gather_fold_allreduce(ctx: SpmdContext, x, op: int):
@@ -390,7 +387,8 @@ def _ring_fold_allreduce(ctx: SpmdContext, x, op: int):
     idx = lax.axis_index(ctx.axis_name)
     shape, dtype = x.shape, x.dtype
     total = x.size
-    chunk_elems = max(1, _ORDERED_RING_CHUNK_BYTES // dtype.itemsize)
+    chunk_elems = max(
+        1, _config.ordered_ring_chunk_bytes() // dtype.itemsize)
     nchunks = -(-total // chunk_elems)
     padded = nchunks * chunk_elems
     flat = x.reshape(-1)
@@ -449,7 +447,8 @@ def _ring_fold_reduce_scatter(ctx: SpmdContext, x, op: int, ax: int,
     seg_elems = shard * math.prod(rest_shape)
     xm = xm.reshape(n, seg_elems)
 
-    chunk_elems = max(1, _ORDERED_RING_CHUNK_BYTES // x.dtype.itemsize)
+    chunk_elems = max(
+        1, _config.ordered_ring_chunk_bytes() // x.dtype.itemsize)
     cps = -(-seg_elems // chunk_elems)            # chunks per segment
     padded = cps * chunk_elems
     if padded != seg_elems:
@@ -519,12 +518,216 @@ def _ordered_fold_allreduce(ctx: SpmdContext, x, op: int):
     if ctx.size == 1:
         return x
     gathered_bytes = x.size * x.dtype.itemsize * ctx.size
-    if gathered_bytes <= _ORDERED_FOLD_GATHER_MAX_BYTES:
+    if gathered_bytes <= _config.ordered_fold_gather_max_bytes():
         return _gather_fold_allreduce(ctx, x, op)
     return _ring_fold_allreduce(ctx, x, op)
 
 
-def _allreduce_fwd_value(ctx: SpmdContext, x, op: int):
+# ---------------------------------------------------------------------------
+# Algorithm schedules (mpi4torch_tpu.tune).  `ring` is the XLA-native
+# default below; these are the explicit latency/topology alternatives.
+# Every combine in them is an explicit combine2 with a FIXED association,
+# so rhd/tree/hier are deterministic by construction (the eager
+# rendezvous folds with the matching association — constants.reduce_rhd/
+# reduce_tree/reduce_grouped — so Mode A and Mode B are bit-comparable
+# per algorithm under deterministic_mode).
+# ---------------------------------------------------------------------------
+
+
+def _rhd_allreduce_value(ctx: SpmdContext, x, op: int):
+    """Recursive-halving/doubling (butterfly) allreduce — the
+    latency-optimal schedule: 2·log2(N) ``collective_permute`` hops of
+    halving/doubling width (vs the ring's ~2(N-1) chunk steps), same
+    2·S·(N-1)/N bytes on the wire.  Power-of-two worlds only.
+
+    Halving phase: at distance ``d = N/2, N/4, …, 1`` each rank keeps
+    the working-buffer half whose segment-index bit ``d`` matches its
+    own rank bit, sends the other half to partner ``rank ^ d`` (one
+    ppermute per round — the xor permutation carries both directions),
+    and combines.  After log2(N) rounds rank ``r`` holds segment ``r``
+    of the reduction in the balanced-tree association of
+    :func:`constants.reduce_rhd`.  Doubling phase: the same butterfly
+    in reverse concatenates the segments back to the full tensor."""
+    n = ctx.size
+    if n == 1:
+        return x
+    if n & (n - 1):
+        raise CommError(
+            f"the 'rhd' (recursive halving/doubling) schedule needs a "
+            f"power-of-two world; got {n} ranks — use 'tree' for the "
+            "logarithmic schedule at this size, or 'ring'")
+    axis = ctx.axis_name
+    idx = lax.axis_index(axis)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    total = flat.size
+    seg = -(-total // n)
+    if seg * n != total:
+        flat = jnp.concatenate([flat, jnp.zeros(seg * n - total, dtype)])
+    buf = flat
+
+    d = n // 2
+    while d >= 1:
+        m = buf.size // 2
+        lo, hi = buf[:m], buf[m:]
+        bit = (idx & d) != 0
+        send = jnp.where(bit, lo, hi)
+        kept = jnp.where(bit, hi, lo)
+        recv = lax.ppermute(send, axis,
+                            perm=[(i, i ^ d) for i in range(n)])
+        buf = C.combine2(op, kept, recv)
+        d //= 2
+
+    d = 1
+    while d < n:
+        recv = lax.ppermute(buf, axis,
+                            perm=[(i, i ^ d) for i in range(n)])
+        bit = (idx & d) != 0
+        buf = jnp.where(bit,
+                        jnp.concatenate([recv, buf]),
+                        jnp.concatenate([buf, recv]))
+        d *= 2
+    return buf[:total].reshape(shape)
+
+
+def _tree_reduce_value(ctx: SpmdContext, x, op: int, root: int):
+    """Binomial-tree reduce-to-root — the inverse of
+    :func:`_tree_bcast_value`'s logarithmic pattern: at step
+    ``s = 2^(k-1), …, 2, 1`` relative ranks ``[s, 2s)`` (when present)
+    send their partials to ``[0, s)``, one full-payload
+    ``collective_permute`` per round, ``ceil(log2 N)`` rounds total.
+    Non-root results are zeroed (the Reduce_ contract).  The
+    association matches :func:`constants.reduce_tree`, so the eager
+    rendezvous fold is bit-identical."""
+    n = ctx.size
+    if n == 1:
+        return x
+    axis = ctx.axis_name
+    idx = lax.axis_index(axis)
+    rel = (idx - root) % n
+    acc = x
+    s = 1
+    while s < n:
+        s *= 2
+    s //= 2
+    while s >= 1:
+        perm = [((r + s + root) % n, (r + root) % n)
+                for r in range(s) if r + s < n]
+        if perm:
+            recv = lax.ppermute(acc, axis, perm=perm)
+            is_recv = (rel < s) & (rel + s < n)
+            acc = jnp.where(is_recv, C.combine2(op, acc, recv), acc)
+        s //= 2
+    return _mask_to_root(ctx, acc, root)
+
+
+def _tree_allreduce_value(ctx: SpmdContext, x, op: int):
+    """Logarithmic tree allreduce: binomial reduce to rank 0
+    (:func:`_tree_reduce_value`) + binomial broadcast back
+    (:func:`_tree_bcast_value`) — 2·ceil(log2 N) full-payload hops,
+    the latency fallback for non-power-of-two worlds where ``rhd``
+    cannot run."""
+    if ctx.size == 1:
+        return x
+    return _tree_bcast_value(ctx, _tree_reduce_value(ctx, x, op, 0), 0)
+
+
+def _hier_group_for(ctx: SpmdContext) -> int:
+    """Intra-group size of the single-axis ``hier`` schedule — the
+    shared tune.resolve_hier_group rule (config.hier_group_size when
+    set, else the sqrt-nearest divisor), single-sourced so Mode A and
+    the eager rendezvous fold can never drift."""
+    from ..tune import resolve_hier_group
+
+    return resolve_hier_group(ctx.size)
+
+
+def _grouped_sum_schedule(x, g: int, rs, ar, ag):
+    """The 2-level SUM allreduce body shared by BOTH hier forms — the
+    single-axis (``axis_index_groups``) and the 2-axis (per-mesh-axis)
+    communicator: pad the flat payload to ``g`` rows, intra-tier
+    reduce-scatter, inter-tier allreduce, intra-tier all-gather.  Each
+    of ``rs``/``ar``/``ag`` is ``(axis_name, axis_index_groups)``
+    (groups ``None`` = the whole named axis).  One implementation so
+    the padding rule and the stage order can never drift between the
+    two forms."""
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    total = flat.size
+    seg = -(-total // g)
+    if seg * g != total:
+        flat = jnp.concatenate([flat, jnp.zeros(seg * g - total, dtype)])
+    xc = flat.reshape(g, seg)
+    part = lax.psum_scatter(xc, rs[0], scatter_dimension=0,
+                            axis_index_groups=rs[1], tiled=True)
+    part = lax.psum(part, ar[0], axis_index_groups=ar[1])
+    out = lax.all_gather(part, ag[0], axis=0, tiled=True,
+                         axis_index_groups=ag[1])
+    return out.reshape(-1)[:total].reshape(shape)
+
+
+def _grouped_ordered_fold(x, op: int, g: int, ngroups: int, inner,
+                          outer):
+    """Deterministic 2-level grouped fold shared by both hier forms:
+    ascending fold within the ``g``-rank inner tier, then ascending
+    fold of the ``ngroups`` group partials — the fixed association of
+    :func:`constants.reduce_grouped`.  ``inner``/``outer``:
+    ``(axis_name, axis_index_groups)``."""
+    stacked = lax.all_gather(x, inner[0], axis=0, tiled=False,
+                             axis_index_groups=inner[1])
+    intra = stacked[0]
+    for i in range(1, g):
+        intra = C.combine2(op, intra, stacked[i])
+    stacked2 = lax.all_gather(intra, outer[0], axis=0, tiled=False,
+                              axis_index_groups=outer[1])
+    out = stacked2[0]
+    for b in range(1, ngroups):
+        out = C.combine2(op, out, stacked2[b])
+    return out
+
+
+def _hier_allreduce_value(ctx: SpmdContext, x, op: int):
+    """Hierarchical 2-level allreduce on a single mesh axis: intra-group
+    reduce-scatter → inter-group allreduce → intra-group all-gather,
+    with groups of ``g`` consecutive ranks (``axis_index_groups``; the
+    2D-mesh form in :class:`HierMeshBackend` keys the tiers off the
+    mesh axes themselves).  Wire per rank:
+    ``2·S·(g-1)/g`` intra + ``2·(S/g)·(n/g-1)/(n/g)`` inter — on a
+    two-tier network (ICI within a host/slice, DCN across) the
+    inter-tier traffic drops by the group factor vs a flat ring.
+
+    SUM outside deterministic mode lowers to the native grouped
+    ``psum_scatter``/``psum``/``all_gather`` triple (one
+    ``stablehlo.reduce_scatter`` + ``all_reduce`` + ``all_gather``, the
+    schedule's census signature); every other case takes the grouped
+    ordered fold — the fixed association of
+    :func:`constants.reduce_grouped`."""
+    n = ctx.size
+    if n == 1:
+        return x
+    axis = ctx.axis_name
+    g = _hier_group_for(ctx)
+    ngroups = n // g
+    inner = [[b * g + i for i in range(g)] for b in range(ngroups)]
+    outer = [[i + b * g for b in range(ngroups)] for i in range(g)]
+
+    if op == C.MPI_SUM and not _config.deterministic_reductions():
+        return _grouped_sum_schedule(x, g, (axis, inner), (axis, outer),
+                                     (axis, inner))
+    # Deterministic / non-native ops: grouped ordered fold (ascending
+    # within each group, then ascending over group partials).
+    return _grouped_ordered_fold(x, op, g, ngroups, (axis, inner),
+                                 (axis, outer))
+
+
+def _allreduce_fwd_value(ctx: SpmdContext, x, op: int,
+                         algorithm: str = "ring"):
+    if algorithm == "rhd":
+        return _rhd_allreduce_value(ctx, x, op)
+    if algorithm == "tree":
+        return _tree_allreduce_value(ctx, x, op)
+    if algorithm == "hier":
+        return _hier_allreduce_value(ctx, x, op)
     if op == C.MPI_SUM:
         if _config.deterministic_reductions():
             return _ordered_fold_allreduce(ctx, x, op)
@@ -548,14 +751,53 @@ def _bwd_scope(opname: str):
     the forward scope's transpose metadata rather than a dedicated span."""
     return jax.named_scope(f"mpi4torch.{opname}Backward")
 
-def allreduce(ctx: SpmdContext, x, op: int):
-    """SPMD Allreduce (reference: csrc/extension.cpp:274-308).  SUM lowers
-    to ``lax.psum`` (self-adjoint); other ops' backward raises, matching
-    MPIUnimplementedNode (csrc/extension.cpp:194-202)."""
+def _auto_allreduce_algorithm(ctx: SpmdContext, x) -> str:
+    """Trace-time auto selection (mpi4torch_tpu.tune): the measured
+    cache winner for this (dtype, size-bucket, nranks, platform) key
+    when one exists, the measured latency crossover when the autotuner
+    has established one, else ``ring``.  Pure function of static call
+    data + the tune cache, and ``run_spmd`` keys its jit cache on the
+    cache generation, so selection can never silently diverge from a
+    compiled program."""
+    from .. import tune as _tune
+
+    xa = jnp.asarray(x)
+    return _tune.select_auto(
+        collective="allreduce",
+        nbytes=xa.size * xa.dtype.itemsize,
+        dtype=xa.dtype, nranks=ctx.size,
+        deterministic=_config.deterministic_reductions())
+
+
+def allreduce(ctx: SpmdContext, x, op: int, algorithm=None,
+              algorithm_explicit: bool = False):
+    """SPMD Allreduce (reference: csrc/extension.cpp:274-308).
+
+    ``algorithm`` picks the wire schedule (mpi4torch_tpu.tune): ``ring``
+    (default; SUM lowers to ``lax.psum``), ``rhd`` (latency-optimal
+    butterfly, power-of-two worlds), ``tree`` (logarithmic, any world),
+    or ``hier`` (2-level grouped).  ``None`` = selector-driven auto
+    choice.  The backward uses the *matching* algorithm — the adjoint of
+    an rhd-sum is an rhd-sum of the cotangents; other ops' backward
+    raises, matching MPIUnimplementedNode (csrc/extension.cpp:194-202).
+
+    ``algorithm_explicit`` carries the facade's degrade/raise rule into
+    validation that only this backend can perform (e.g. a
+    ``config.hier_group_size`` that does not divide THIS communicator):
+    explicit requests raise, scope defaults degrade to ``ring``."""
+    if algorithm is None:
+        algorithm = _auto_allreduce_algorithm(ctx, x)
+    if algorithm == "hier" and ctx.size > 1:
+        try:
+            _hier_group_for(ctx)
+        except CommError:
+            if algorithm_explicit:
+                raise
+            algorithm = "ring"
 
     @jax.custom_vjp
     def f(v):
-        return _allreduce_fwd_value(ctx, v, op)
+        return _allreduce_fwd_value(ctx, v, op, algorithm)
 
     def bwd(_, g):
         if op != C.MPI_SUM:
@@ -565,9 +807,10 @@ def allreduce(ctx: SpmdContext, x, op: int):
                 "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
             )
         with _bwd_scope("Allreduce"):
-            return (_allreduce_fwd_value(ctx, g, C.MPI_SUM),)
+            return (_allreduce_fwd_value(ctx, g, C.MPI_SUM, algorithm),)
 
-    f.defvjp(lambda v: (_allreduce_fwd_value(ctx, v, op), None), bwd)
+    f.defvjp(lambda v: (_allreduce_fwd_value(ctx, v, op, algorithm), None),
+             bwd)
     return f(x)
 
 
@@ -590,15 +833,15 @@ def _mask_to_root(ctx: SpmdContext, x, root: int):
 #                      ~2(N-1) chunk steps for small S and loses for large.
 # Crossover at ICI-like alpha/bw sits near a few hundred KiB; 256 KiB is
 # the conservative static switch (shapes are static under jit, so the
-# choice is per-callsite and compiles to exactly one strategy).
-# bench_tradeoffs.py sweeps both lowerings head-to-head across the
-# threshold on whatever hardware is attached — re-run it on a real chip
-# to recalibrate this constant.  Calibration NEEDS n > 1 devices: on a
+# choice is per-callsite and compiles to exactly one strategy).  The
+# threshold lives in config.py (config.bcast_tree_max_bytes, validated
+# setter; the tune autotuner can override it from measurement) and
+# bench_tradeoffs.py sweeps both lowerings head-to-head across it on
+# whatever hardware is attached.  Calibration NEEDS n > 1 devices: on a
 # single chip both lowerings degenerate to identity (a 1-rank Bcast has
 # no wire), so the one-chip environment available through round 5 can
 # never measure this crossover — the sweep is armed for the first
 # multi-chip run.
-_BCAST_TREE_MAX_BYTES = 256 * 1024
 
 
 def _tree_bcast_value(ctx: SpmdContext, x, root: int):
@@ -618,49 +861,61 @@ def _tree_bcast_value(ctx: SpmdContext, x, root: int):
     return val
 
 
-def _bcast_value(ctx: SpmdContext, x, root: int):
+def _bcast_value(ctx: SpmdContext, x, root: int, algorithm=None):
     if ctx.size == 1:
         return x
+    if algorithm == "tree":
+        return _tree_bcast_value(ctx, x, root)
+    if algorithm == "ring":
+        return lax.psum(_mask_to_root(ctx, x, root), ctx.axis_name)
     size_bytes = x.size * x.dtype.itemsize
-    if size_bytes <= _BCAST_TREE_MAX_BYTES:
+    if size_bytes <= _config.bcast_tree_max_bytes():
         return _tree_bcast_value(ctx, x, root)
     # Root-masked psum: adding zeros is exact for floats, so this is
     # value-identical to the tree path for every dtype and root.
     return lax.psum(_mask_to_root(ctx, x, root), ctx.axis_name)
 
 
-def _reduce_value(ctx: SpmdContext, x, op: int, root: int):
+def _reduce_value(ctx: SpmdContext, x, op: int, root: int,
+                  algorithm=None):
+    if algorithm == "tree":
+        return _tree_reduce_value(ctx, x, op, root)
     red = _allreduce_fwd_value(ctx, x, op)
     # Non-root results are zeroed (reference: csrc/extension.cpp:443-447).
     return _mask_to_root(ctx, red, root)
 
 
-def bcast_(ctx: SpmdContext, x, root: int):
+def bcast_(ctx: SpmdContext, x, root: int, algorithm=None):
     """SPMD broadcast (reference: csrc/extension.cpp:333-365); adjoint is
-    Reduce_(SUM, root) (csrc/extension.cpp:310-331)."""
+    Reduce_(SUM, root) on the matching algorithm
+    (csrc/extension.cpp:310-331).  ``algorithm``: ``tree`` pins the
+    binomial-tree lowering, ``ring`` the root-masked psum; ``None``
+    keeps the size dispatch (config.bcast_tree_max_bytes)."""
     _check_root(ctx, root)
 
     @jax.custom_vjp
     def f(v):
-        return _bcast_value(ctx, v, root)
+        return _bcast_value(ctx, v, root, algorithm)
 
     def bwd(_, g):
         with _bwd_scope("Bcast"):
-            return (_reduce_value(ctx, g, C.MPI_SUM, root),)
+            return (_reduce_value(ctx, g, C.MPI_SUM, root, algorithm),)
 
-    f.defvjp(lambda v: (_bcast_value(ctx, v, root), None), bwd)
+    f.defvjp(lambda v: (_bcast_value(ctx, v, root, algorithm), None), bwd)
     return f(x)
 
 
-def reduce_(ctx: SpmdContext, x, op: int, root: int):
+def reduce_(ctx: SpmdContext, x, op: int, root: int, algorithm=None):
     """SPMD reduce-to-root with zeroed non-root results (reference:
-    csrc/extension.cpp:405-464); adjoint is Bcast_(root); only SUM
-    differentiable."""
+    csrc/extension.cpp:405-464); adjoint is Bcast_(root) on the matching
+    algorithm; only SUM differentiable.  ``algorithm``: ``tree`` pins
+    the binomial reduce (``ceil(log2 N)`` permute hops instead of a
+    masked all-reduce); ``ring``/``None`` the masked psum form."""
     _check_root(ctx, root)
 
     @jax.custom_vjp
     def f(v):
-        return _reduce_value(ctx, v, op, root)
+        return _reduce_value(ctx, v, op, root, algorithm)
 
     def bwd(_, g):
         if op != C.MPI_SUM:
@@ -670,9 +925,10 @@ def reduce_(ctx: SpmdContext, x, op: int, root: int):
                 "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
             )
         with _bwd_scope("Reduce"):
-            return (_bcast_value(ctx, g, root),)
+            return (_bcast_value(ctx, g, root, algorithm),)
 
-    f.defvjp(lambda v: (_reduce_value(ctx, v, op, root), None), bwd)
+    f.defvjp(lambda v: (_reduce_value(ctx, v, op, root, algorithm), None),
+             bwd)
     return f(x)
 
 
@@ -743,7 +999,7 @@ def reduce_scatter(ctx: SpmdContext, x, op: int, scatteraxis: int):
         # memory, shard-sized output, VERDICT r4 weak 2) delivers each
         # rank its segment of the same ascending-rank bits directly.
         if v.size * v.dtype.itemsize * ctx.size \
-                <= _ORDERED_FOLD_GATHER_MAX_BYTES:
+                <= _config.ordered_fold_gather_max_bytes():
             stacked = lax.all_gather(v, ctx.axis_name, axis=0, tiled=False)
             pieces = lax.dynamic_slice_in_dim(stacked, start, shard, 1 + ax)
             out = pieces[0]
@@ -1060,8 +1316,9 @@ class SpmdBackend:
     def size(self) -> int:
         return self._ctx.size
 
-    def allreduce(self, x, op):
-        return allreduce(self._ctx, x, op)
+    def allreduce(self, x, op, algorithm=None, algorithm_explicit=False):
+        return allreduce(self._ctx, x, op, algorithm,
+                         algorithm_explicit=algorithm_explicit)
 
     def allreduce_compressed(self, x, op, codec):
         from ..compress import spmd as _cspmd
@@ -1071,11 +1328,11 @@ class SpmdBackend:
         from ..compress import spmd as _cspmd
         return _cspmd.allgather(self._ctx, x, gatheraxis, codec)
 
-    def bcast_(self, x, root):
-        return bcast_(self._ctx, x, root)
+    def bcast_(self, x, root, algorithm=None):
+        return bcast_(self._ctx, x, root, algorithm)
 
-    def reduce_(self, x, op, root):
-        return reduce_(self._ctx, x, op, root)
+    def reduce_(self, x, op, root, algorithm=None):
+        return reduce_(self._ctx, x, op, root, algorithm)
 
     def gather(self, x, gatheraxis, root):
         return gather(self._ctx, x, gatheraxis, root)
@@ -1126,12 +1383,161 @@ class _bind_spmd:
         return False
 
 
-def comm_from_mesh(mesh, axis_name: str):
+class HierMeshBackend:
+    """Two-tier communicator over TWO mesh axes ``(outer, inner)`` —
+    the topology-aware form of the ``hier`` algorithm, keyed off the
+    mesh axis sizes themselves (``comm_from_mesh(mesh, ("dp", "tp"))``):
+    ranks are row-major over (outer, inner), the inner axis is the fast
+    tier (ICI within a slice/host), the outer axis the slow one (DCN).
+
+    Allreduce-only by design: the 2-level schedule — intra-group
+    (inner-axis) reduce-scatter → inter-group (outer-axis) allreduce →
+    intra-group all-gather — is what a 2D mesh buys; every other op
+    needs a single-axis communicator (``comm_from_mesh`` with one axis
+    name) and raises a :class:`CommError` pointing there."""
+
+    # The facade degrades scope-default codecs on backends without a
+    # compressed pipeline (and raises for explicit ones) — see
+    # comm.Allreduce.
+    supports_compression = False
+    # The registry's flat-world applicability gates don't apply here
+    # (the tiers ARE the mesh axes): the facade skips them and this
+    # backend enforces its own hier/ring contract — see comm.Allreduce.
+    owns_algorithm_resolution = True
+
+    # The backend-method surface this communicator deliberately does
+    # NOT serve.  __getattr__ raises the informative CommError for
+    # exactly these; everything else (dunders, hasattr probes, copy/
+    # pickle protocol lookups) gets the protocol-correct
+    # AttributeError.
+    _UNSUPPORTED_OPS = frozenset({
+        "bcast_", "reduce_", "gather", "allgather", "reduce_scatter",
+        "scatter", "alltoall", "isend", "irecv", "wait",
+        "allreduce_compressed", "allgather_compressed",
+    })
+
+    def __init__(self, axis_names: Tuple[str, str],
+                 axis_sizes: Tuple[int, int]):
+        self.axis_names = tuple(axis_names)
+        self.axis_sizes = tuple(int(s) for s in axis_sizes)
+
+    @property
+    def rank(self):
+        outer, inner = self.axis_names
+        return (lax.axis_index(outer) * self.axis_sizes[1]
+                + lax.axis_index(inner))
+
+    @property
+    def size(self) -> int:
+        return self.axis_sizes[0] * self.axis_sizes[1]
+
+    def allreduce(self, x, op, algorithm=None, algorithm_explicit=False):
+        return hier_allreduce_2d(self, x, op, algorithm,
+                                 explicit=algorithm_explicit)
+
+    def __getattr__(self, name):
+        if name in HierMeshBackend._UNSUPPORTED_OPS:
+            raise CommError(
+                "hierarchical 2-axis mesh communicators support "
+                f"Allreduce only (the 2-level wire schedule); {name!r} "
+                "needs a single-axis communicator — use "
+                "comm_from_mesh(mesh, axis_name) with one axis")
+        raise AttributeError(name)
+
+
+def _hier2d_fwd_value(hb: HierMeshBackend, x, op: int, algorithm: str):
+    outer, inner = hb.axis_names
+    so, si = hb.axis_sizes
+    if so * si == 1:
+        return x
+    det = _config.deterministic_reductions()
+    if not det and op == C.MPI_SUM:
+        if algorithm == "ring":
+            return lax.psum(x, hb.axis_names)
+        return _grouped_sum_schedule(x, si, (inner, None), (outer, None),
+                                     (inner, None))
+    if not det and op == C.MPI_MAX:
+        return lax.pmax(x, hb.axis_names)
+    if not det and op == C.MPI_MIN:
+        return lax.pmin(x, hb.axis_names)
+    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+        C.combine2(op, x, x)  # raises with explanation
+    # Deterministic / non-native ops: grouped ordered fold — inner tier
+    # first (ascending within the si-rank group), then ascending over
+    # group partials: the association of constants.reduce_grouped with
+    # group = the inner axis size.
+    return _grouped_ordered_fold(x, op, si, so, (inner, None),
+                                 (outer, None))
+
+
+def hier_allreduce_2d(hb: HierMeshBackend, x, op: int, algorithm=None,
+                      explicit: bool = False):
+    """Differentiable 2-level allreduce over a 2-axis mesh communicator;
+    the adjoint is the same 2-level collective on the cotangents.
+
+    The facade's degrade/raise rule applies to algorithms this backend
+    cannot lower (``rhd``/``tree`` need a single axis): an explicit
+    request raises, a scope/process default yields to ``hier`` — the
+    communicator's own topology-native schedule."""
+    if algorithm in (None, "auto"):
+        algorithm = "hier"
+    if algorithm not in ("hier", "ring"):
+        if not explicit:
+            algorithm = "hier"
+        else:
+            raise CommError(
+                f"a 2-axis mesh communicator lowers algorithm 'hier' "
+                f"(the 2-level schedule) or 'ring' (flat psum over both "
+                f"axes); got {algorithm!r} — rhd/tree need a "
+                "single-axis communicator")
+
+    @jax.custom_vjp
+    def f(v):
+        return _hier2d_fwd_value(hb, v, op, algorithm)
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Allreduce with {C.op_name(op)} is not "
+                "implemented — only MPI_SUM is differentiable (reference: "
+                "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
+            )
+        with _bwd_scope("Allreduce"):
+            return (_hier2d_fwd_value(hb, g, C.MPI_SUM, algorithm),)
+
+    f.defvjp(lambda v: (_hier2d_fwd_value(hb, v, op, algorithm), None),
+             bwd)
+    return f(x)
+
+
+def comm_from_mesh(mesh, axis_name):
     """Adopt a mesh axis as a communicator for use inside the caller's own
     ``shard_map``/``pjit`` region — the TPU-native analogue of the
     reference's foreign-communicator interop (csrc/extension.cpp:168-171,
-    src/__init__.py:247-261)."""
+    src/__init__.py:247-261).
+
+    A TUPLE of two axis names ``(outer, inner)`` adopts both axes as a
+    two-tier hierarchical communicator (:class:`HierMeshBackend`): its
+    ``Allreduce`` runs the 2-level ``hier`` schedule keyed off the mesh
+    axis sizes — intra-``inner`` reduce-scatter, inter-``outer``
+    allreduce, intra-``inner`` all-gather."""
     from ..comm import MPI_Communicator
+
+    if isinstance(axis_name, (tuple, list)):
+        names = tuple(axis_name)
+        if len(names) != 2:
+            raise CommError(
+                "a hierarchical communicator takes exactly two axis "
+                f"names (outer, inner); got {names!r}")
+        for nm in names:
+            if nm not in mesh.axis_names:
+                raise CommError(
+                    f"axis {nm!r} not in mesh axes {mesh.axis_names}")
+        sizes = tuple(mesh.shape[nm] for nm in names)
+        backend = HierMeshBackend(names, sizes)
+        comm = MPI_Communicator(lambda: backend)
+        comm._hier_axes = (names, sizes)
+        return comm
 
     if axis_name not in mesh.axis_names:
         raise CommError(
@@ -1256,31 +1662,43 @@ def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
         mesh = Mesh(np.asarray(devs[:n]), (axis_name,))
     size = mesh.shape[axis_name]
 
-    def wrapped(det, comp, bb, *args):
+    def wrapped(det, comp, bb, algo, _tune_key, *args):
+        # _tune_key (thresholds fingerprint + tune cache generation) is
+        # jit-cache-key-only: the values are read inside the trace via
+        # config/tune, the static arg just forces a retrace when they
+        # change.
         ctx = SpmdContext(axis_name=axis_name, size=size)
         with _bind_spmd(ctx), _config.deterministic_mode(det), \
-                _config.compression_scope(comp), _config.fusion_scope(bb):
+                _config.compression_scope(comp), \
+                _config.fusion_scope(bb), _config.algorithm_scope(algo):
             out = fn(*args)
         return jax.tree.map(lambda y: jnp.expand_dims(y, 0), out)
 
-    def sm(det, comp, bb, *args):
-        return shard_map(lambda *a: wrapped(det, comp, bb, *a), mesh=mesh,
-                         in_specs=P(), out_specs=P(axis_name),
+    def sm(det, comp, bb, algo, tk, *args):
+        return shard_map(lambda *a: wrapped(det, comp, bb, algo, tk, *a),
+                         mesh=mesh, in_specs=P(), out_specs=P(axis_name),
                          check_vma=False)(*args)
 
     if jit:
-        jitted = jax.jit(sm, static_argnums=(0, 1, 2))
+        jitted = jax.jit(sm, static_argnums=(0, 1, 2, 3, 4))
     else:
         jitted = sm
 
     def call(*args):
-        # The deterministic-reductions flag, the compression default and
-        # the fusion bucket size are read at *call* time and made part of
-        # the jit cache key (static args), so toggling any of them after
-        # the first call retraces instead of silently reusing the old
+        # The deterministic-reductions flag, the compression default,
+        # the fusion bucket size, the algorithm default, and the
+        # schedule thresholds + tune-cache generation are read at *call*
+        # time and made part of the jit cache key (static args), so
+        # toggling any of them — or the autotuner recording a new
+        # winner — retraces instead of silently reusing the old
         # lowering.
+        from .. import tune as _tune
+
         return jitted(_config.deterministic_reductions(),
                       _config.default_compression(),
-                      _config.default_bucket_bytes(), *args)
+                      _config.default_bucket_bytes(),
+                      _config.default_algorithm(),
+                      (_config.thresholds_fingerprint(),
+                       _tune.generation()), *args)
 
     return call

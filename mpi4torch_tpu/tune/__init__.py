@@ -1,0 +1,182 @@
+"""Size- and topology-aware collective algorithm selection.
+
+The SPMD backend can emit several schedules for the same collective
+(``ring``/``rhd``/``tree``/``hier`` — see :mod:`.registry`); which one
+is fastest depends on message size, rank count, and topology.  This
+package decides:
+
+* **per call** — ``comm.Allreduce(x, op, algorithm="rhd")``;
+* **per scope** — ``with mpi.config.algorithm_scope("tree"): ...``
+  (or process-wide via :func:`mpi4torch_tpu.config.set_default_algorithm`);
+* **by default** — the selector: the persisted autotuner cache's
+  measured winner for the ``(collective, dtype, nbytes-bucket, nranks,
+  platform)`` key when one exists, the measured latency crossover
+  (:func:`mpi4torch_tpu.config.latency_crossover_bytes`) when the
+  autotuner has established one, and ``ring`` otherwise — auto-selection
+  never deviates from the XLA-native ring on a guess, only on
+  measurement.
+
+Degrade/raise rule (mirrors the compression scope's): a *scope or
+process default* that cannot legally serve a call — ``rhd`` on a
+non-power-of-two world, ``hier`` on a prime world, any non-ring
+algorithm under a wire codec that declares itself ring-only (``q8``) —
+silently falls back to auto selection (``ring`` unless measured
+evidence says otherwise, and for ``Bcast_``/``Reduce_`` the normal
+size dispatch); an *explicit per-call* ``algorithm=`` raises with the
+reason instead.
+
+Run the measurement with :func:`autotune_allreduce` (or ``make
+tune-smoke`` / ``python -m mpi4torch_tpu.tune.autotuner``); winners
+persist to a versioned JSON cache file (safe to delete — see
+:mod:`.autotuner`) so later processes select tuned algorithms with zero
+measurement overhead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import config as _config
+from ..runtime import CommError
+from .autotuner import (autotune_allreduce, cache_path, clear,
+                        ensure_tuned_allreduce, entry_from_disk,
+                        generation, lookup, lookup_algorithm, make_key,
+                        record)
+from .registry import (AlgorithmSpec, available_algorithms, best_group,
+                       get_algorithm, register_algorithm)
+
+__all__ = [
+    "AlgorithmSpec",
+    "available_algorithms",
+    "best_group",
+    "get_algorithm",
+    "register_algorithm",
+    "resolve_request",
+    "resolve_hier_group",
+    "select_auto",
+    "codec_algorithms",
+    "autotune_allreduce",
+    "ensure_tuned_allreduce",
+    "lookup",
+    "lookup_algorithm",
+    "entry_from_disk",
+    "record",
+    "make_key",
+    "cache_path",
+    "generation",
+    "clear",
+]
+
+
+def codec_algorithms(codec) -> tuple:
+    """The wire algorithms ``codec`` declares it composes with
+    (``Codec.algorithms``; codecs predating the field are ring-only —
+    the conservative reading, since the compressed pipeline is a ring)."""
+    return tuple(getattr(codec, "algorithms", ("ring",)))
+
+
+def resolve_request(requested, *, collective: str = "allreduce",
+                    nranks: int = 1,
+                    explicit: bool = False) -> Optional[str]:
+    """Resolve a facade ``algorithm=`` request to a concrete algorithm
+    name, or ``None`` for selector-driven auto choice.
+
+    ``requested`` is the explicit per-call argument when ``explicit``,
+    else the scope/process default.  Unknown names always raise (a typo
+    is a bug at any level); an *applicability* failure raises only for
+    explicit requests and voids scope defaults back to auto selection
+    (None) — NOT to a pinned ``"ring"``, so e.g. an allreduce-oriented
+    ``algorithm_scope("rhd")`` leaves a small ``Bcast_``'s tree/psum
+    size dispatch untouched instead of silently pinning the psum form.
+    ``False``/``"auto"`` mean selector-driven choice (the explicit
+    spelling overrides an active ``algorithm_scope``)."""
+    if requested is None or requested is False or requested == "auto":
+        return None
+    spec = get_algorithm(requested)  # raises on unknown names
+    reason = spec.why_not(nranks, collective)
+    if reason is None:
+        return spec.name
+    if explicit:
+        raise CommError(reason)
+    return None
+
+
+def resolve_hier_group(nranks: int) -> int:
+    """THE intra-group size of the flat-axis ``hier`` schedule for an
+    ``nranks`` communicator — the single source both backends consult
+    (ops/spmd.py ``_hier_group_for`` and the eager rendezvous fold), so
+    the validity rule can never drift between Mode A and Mode B.
+
+    ``config.hier_group_size()`` when set (validated against THIS
+    communicator), else the divisor of ``nranks`` closest to its square
+    root.  Raises :class:`CommError` when no valid 2-level split
+    exists — callers holding a scope default catch it and fall back to
+    auto selection (the degrade/raise rule); explicit requests let it
+    propagate."""
+    g = _config.hier_group_size()
+    if g is not None:
+        if nranks % g or not (1 < g < nranks):
+            raise CommError(
+                f"config.hier_group_size={g} does not define a 2-level "
+                f"split of the {nranks}-rank communicator (need a "
+                f"divisor with 1 < g < {nranks})")
+        return g
+    g = best_group(nranks)
+    if g is None:
+        raise CommError(
+            f"the 'hier' schedule needs a 2-level group factorization "
+            f"of the world size; {nranks} has no nontrivial divisor — "
+            "use 'tree' or 'ring'")
+    return g
+
+
+def select_auto(*, collective: str = "allreduce", nbytes: int,
+                dtype, nranks: int, deterministic: bool = False,
+                codec=None) -> str:
+    """The selector: concrete algorithm for an auto (no explicit
+    request, no scope default) collective call.  Pure function of the
+    call signature, the config knobs, and the autotuner cache — the
+    same inputs always pick the same algorithm (``run_spmd`` keys its
+    jit cache on the config fingerprint and the cache generation, so a
+    cache update retraces rather than silently diverging).
+
+    Order: deterministic mode pins ``ring`` (the bit-exact ordered
+    fold); a measured cache winner wins; below the measured latency
+    crossover the latency-optimal algorithm wins (``rhd`` on
+    power-of-two worlds, else ``tree``); otherwise ``ring``.  A codec
+    restricts candidates to the algorithms it declares (``q8`` is
+    ring-only)."""
+    if nranks <= 1 or deterministic:
+        return "ring"
+
+    def ok(name: str) -> bool:
+        if not get_algorithm(name).applicable(nranks, collective):
+            return False
+        if name == "hier":
+            # The registry gate is static (a nontrivial divisor
+            # exists); a set config.hier_group_size can still void it
+            # for THIS communicator — auto selection must never return
+            # an algorithm the backend would reject.
+            try:
+                resolve_hier_group(nranks)
+            except CommError:
+                return False
+        if codec is None:
+            return True
+        # One enforcement path for codec/algorithm composition: the
+        # same predicate the facade and the fused per-bucket picker
+        # consult (compress.codec_applicable, algorithm leg).
+        from ..compress import codec_applicable
+
+        return codec_applicable(codec, dtype, algorithm=name)
+
+    winner = lookup_algorithm(collective, dtype, nbytes, nranks)
+    if winner is not None and ok(winner):
+        return winner
+    crossover = _config.latency_crossover_bytes()
+    if crossover is not None and nbytes <= crossover:
+        if ok("rhd"):
+            return "rhd"
+        if ok("tree"):
+            return "tree"
+    return "ring"

@@ -1,0 +1,397 @@
+"""Measurement-driven algorithm autotuner with a persistent cache.
+
+The selector (:mod:`mpi4torch_tpu.tune`) deviates from ``ring`` only on
+evidence.  This module produces that evidence: it benchmarks every
+applicable algorithm per ``(collective, dtype, nbytes-bucket, nranks,
+platform)`` key, records the winner in an in-process table, and
+persists the table to a JSON cache file so later *processes* skip the
+measurement entirely — steady-state steps pay zero tuning overhead.
+
+Cache file contract:
+
+* location — ``$MPI4TORCH_TPU_TUNE_CACHE`` if set, else
+  ``~/.cache/mpi4torch_tpu/tune_cache.json``;
+* versioned — the top-level ``version`` field must equal
+  :data:`CACHE_VERSION`; a mismatched, corrupt, truncated, or
+  hand-edited-beyond-recognition file is silently ignored (selection
+  falls back to the defaults — the cache is *safe to delete at any
+  time*);
+* written atomically (tmp + rename) and best-effort: an unwritable
+  cache directory degrades to in-process-only tuning, never an error.
+
+Message sizes are bucketed to the next power of two, so one
+measurement covers the whole bucket — the same coarse keying
+production autotuners use (a 3 KiB and a 4 KiB allreduce want the same
+schedule).
+
+``python -m mpi4torch_tpu.tune.autotuner [--smoke]`` runs the sweep
+from the command line and prints the JSON report (``make tune-smoke``
+drives the CPU smoke variant).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .. import config as _config
+from .registry import available_algorithms, get_algorithm
+
+CACHE_VERSION = 1
+
+_mem: Dict[str, dict] = {}
+_from_disk: set = set()
+_file_loaded = False
+_generation = 0
+
+
+def cache_path() -> str:
+    """Path of the persistent cache file (see module docstring)."""
+    env = os.environ.get("MPI4TORCH_TPU_TUNE_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "mpi4torch_tpu", "tune_cache.json")
+
+
+def _bucket(nbytes: int) -> int:
+    """Next power of two ≥ nbytes (≥ 1) — the cache's size key."""
+    nbytes = max(int(nbytes), 1)
+    return 1 << (nbytes - 1).bit_length()
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def make_key(collective: str, dtype, nbytes: int, nranks: int,
+             platform: Optional[str] = None) -> str:
+    import numpy as np
+
+    if platform is None:
+        platform = _platform()
+    return "|".join([collective, str(np.dtype(dtype)),
+                     str(_bucket(nbytes)), str(int(nranks)), platform])
+
+
+def _load() -> None:
+    """Lazily merge the disk cache into the in-process table.  Any
+    defect — missing file, bad JSON, wrong version, malformed entries —
+    is treated as 'no cache': defaults apply, nothing crashes."""
+    global _file_loaded
+    if _file_loaded:
+        return
+    _file_loaded = True
+    try:
+        with open(cache_path(), "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return
+    if not isinstance(data, dict) or data.get("version") != CACHE_VERSION:
+        return
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return
+    for key, ent in entries.items():
+        if (isinstance(key, str) and isinstance(ent, dict)
+                and isinstance(ent.get("algorithm"), str)
+                and key not in _mem):
+            _mem[key] = ent
+            _from_disk.add(key)
+
+
+def _save() -> None:
+    """Atomic, best-effort persist of the in-process table."""
+    path = cache_path()
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": CACHE_VERSION, "entries": _mem}, f,
+                      indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def lookup(collective: str, dtype, nbytes: int, nranks: int,
+           platform: Optional[str] = None) -> Optional[dict]:
+    """The cached entry for this key, or None.  Entries naming an
+    algorithm the registry no longer knows (stale cache across
+    versions) are ignored."""
+    _load()
+    ent = _mem.get(make_key(collective, dtype, nbytes, nranks, platform))
+    if ent is None:
+        return None
+    try:
+        get_algorithm(ent["algorithm"])
+    except (ValueError, KeyError, TypeError):
+        return None
+    return ent
+
+
+def lookup_algorithm(collective: str, dtype, nbytes: int, nranks: int,
+                     platform: Optional[str] = None) -> Optional[str]:
+    ent = lookup(collective, dtype, nbytes, nranks, platform)
+    return None if ent is None else ent["algorithm"]
+
+
+def entry_from_disk(collective: str, dtype, nbytes: int, nranks: int,
+                    platform: Optional[str] = None) -> bool:
+    """True when this key's entry was loaded from the persisted file
+    (rather than measured in this process) — the bench's
+    ``tuned_from_cache`` evidence."""
+    _load()
+    return make_key(collective, dtype, nbytes, nranks,
+                    platform) in _from_disk
+
+
+def record(collective: str, dtype, nbytes: int, nranks: int,
+           algorithm: str, platform: Optional[str] = None,
+           measurements: Optional[dict] = None,
+           persist: bool = True) -> str:
+    """Store a winner for a key (and persist).  Bumps the selection
+    generation so ``run_spmd`` jit cache keys see the change and
+    retrace instead of reusing a lowering picked under the old table."""
+    global _generation
+    _load()
+    get_algorithm(algorithm)  # validate
+    key = make_key(collective, dtype, nbytes, nranks, platform)
+    ent = {"algorithm": algorithm, "measured_at": time.time()}
+    if measurements:
+        ent["measurements"] = measurements
+    _mem[key] = ent
+    _from_disk.discard(key)
+    _generation += 1
+    if persist:
+        _save()
+    return key
+
+
+def generation() -> int:
+    """Monotonic counter bumped on every cache mutation; part of
+    ``run_spmd``'s jit cache key."""
+    return _generation
+
+
+def clear(remove_file: bool = False) -> None:
+    """Drop the in-process table (and optionally the persisted file);
+    the next lookup re-reads the file, so ``clear()`` alone round-trips
+    the persisted entries while ``clear(remove_file=True)`` resets
+    selection to the defaults."""
+    global _file_loaded, _generation
+    _mem.clear()
+    _from_disk.clear()
+    _file_loaded = False
+    _generation += 1
+    if remove_file:
+        try:
+            os.remove(cache_path())
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Measurement
+# ---------------------------------------------------------------------------
+
+SMOKE_SIZES = (1 << 10, 1 << 14, 1 << 18)           # 1 KiB → 256 KiB
+DEFAULT_SIZES = tuple(1 << s for s in range(10, 27, 2))   # 1 KiB → 64 MiB
+
+
+def _candidates(nranks: int, collective: str = "allreduce") -> List[str]:
+    out = []
+    for name in available_algorithms():
+        if get_algorithm(name).applicable(nranks, collective):
+            out.append(name)
+    return out
+
+
+def _time_step(step, x, iters: int) -> float:
+    """Median seconds/step with a host fetch per iteration (the only
+    completion barrier remote runtimes honor — see bench.py ``_force``;
+    ``np.asarray`` of one output leaf is the cheap equivalent here)."""
+    import jax
+    import numpy as np
+
+    def force(out):
+        leaf = jax.tree.leaves(out)[0]
+        np.asarray(leaf[(slice(None),) + (0,) * (leaf.ndim - 1)])
+
+    force(step(x))          # compile + warmup
+    force(step(x))
+    times = []
+    for _ in range(max(int(iters), 1)):
+        t0 = time.perf_counter()
+        force(step(x))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
+                       nranks: Optional[int] = None,
+                       dtype=None, iters: int = 5,
+                       persist: bool = True,
+                       apply_crossover: bool = True) -> dict:
+    """Benchmark every applicable allreduce algorithm at each payload
+    size, record the winners in the cache, and (by default) set
+    :func:`config.set_latency_crossover_bytes` from the measured
+    crossover so auto-selection reflects the measurement.
+
+    Returns the report dict (also the bench's JSON stanza):
+    per-size per-algorithm seconds and GB/s, the winner table, the
+    crossover, and ``tuned_from_cache: False`` (a report served
+    without measuring — :func:`ensure_tuned_allreduce` — says True,
+    with ``from_disk`` distinguishing a persisted-file round-trip from
+    same-process memory)."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4torch_tpu as mpi
+
+    if dtype is None:
+        dtype = jnp.float32
+    n = nranks or len(jax.devices())
+    sizes = tuple(sizes) if sizes else DEFAULT_SIZES
+    platform = _platform()
+    itemsize = jnp.dtype(dtype).itemsize
+    comm = mpi.COMM_WORLD
+
+    report = {
+        "collective": "allreduce",
+        "nranks": n,
+        "dtype": str(jnp.dtype(dtype)),
+        "platform": platform,
+        "cache_file": cache_path(),
+        "tuned_from_cache": False,
+        "entries": {},
+    }
+
+    def step_fn(algorithm):
+        def body(x):
+            return comm.Allreduce(x, mpi.MPI_SUM, algorithm=algorithm)
+
+        return mpi.run_spmd(body, nranks=n)
+
+    for nbytes in sizes:
+        nelem = max(1, int(nbytes) // itemsize)
+        x = jnp.ones((nelem,), dtype)
+        wire = 2.0 * (n - 1) / n * nelem * itemsize if n > 1 \
+            else float(nelem * itemsize)
+        per = {}
+        for name in _candidates(n):
+            try:
+                dt = _time_step(step_fn(name), x, iters)
+            except Exception as e:  # noqa: BLE001 — sweep must finish
+                per[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+                continue
+            per[name] = {"seconds_per_step": dt,
+                         "gbps": round(wire / dt / 1e9, 4)}
+        timed = {k: v for k, v in per.items()
+                 if "seconds_per_step" in v}
+        if not timed:
+            report["entries"][str(int(nbytes))] = {"algorithms": per}
+            continue
+        winner = min(timed, key=lambda k: timed[k]["seconds_per_step"])
+        record("allreduce", dtype, int(nbytes), n, winner,
+               platform=platform, measurements={
+                   k: v["seconds_per_step"] for k, v in timed.items()},
+               persist=persist)
+        report["entries"][str(int(nbytes))] = {
+            "algorithms": per,
+            "winner": winner,
+            "winner_latency_optimal":
+                get_algorithm(winner).latency_optimal,
+        }
+
+    crossover = _crossover_from(report["entries"])
+    report["crossover_bytes"] = crossover
+    if apply_crossover and crossover is not None:
+        _config.set_latency_crossover_bytes(crossover)
+        report["applied_latency_crossover_bytes"] = crossover
+    return report
+
+
+def _crossover_from(entries: dict) -> Optional[int]:
+    """Largest measured payload size whose winner is latency-optimal —
+    the ring/latency-algorithm crossover point (None when ring wins
+    everywhere, i.e. the latency regime was not reached)."""
+    best = None
+    for size_str, ent in entries.items():
+        if ent.get("winner_latency_optimal"):
+            size = int(size_str)
+            best = size if best is None else max(best, size)
+    return best
+
+
+def ensure_tuned_allreduce(sizes: Optional[Sequence[int]] = None,
+                           nranks: Optional[int] = None,
+                           dtype=None, iters: int = 5,
+                           persist: bool = True,
+                           apply_crossover: bool = True) -> dict:
+    """Like :func:`autotune_allreduce`, but when every requested size
+    already has a cached winner, build the report from the cache
+    (``tuned_from_cache: True``) and skip the measurement — the
+    steady-state zero-overhead path.  ``from_disk`` in the report says
+    whether ALL served entries came from the persisted file (a real
+    cross-process round-trip) rather than this process's own earlier
+    measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    n = nranks or len(jax.devices())
+    sizes = tuple(sizes) if sizes else DEFAULT_SIZES
+    platform = _platform()
+
+    cached = {}
+    from_disk = True
+    for nbytes in sizes:
+        ent = lookup("allreduce", dtype, int(nbytes), n, platform)
+        if ent is None:
+            return autotune_allreduce(sizes=sizes, nranks=n, dtype=dtype,
+                                      iters=iters, persist=persist,
+                                      apply_crossover=apply_crossover)
+        from_disk = from_disk and entry_from_disk(
+            "allreduce", dtype, int(nbytes), n, platform)
+        cached[str(int(nbytes))] = {
+            "winner": ent["algorithm"],
+            "winner_latency_optimal":
+                get_algorithm(ent["algorithm"]).latency_optimal,
+            "measurements": ent.get("measurements"),
+        }
+    crossover = _crossover_from(cached)
+    if apply_crossover and crossover is not None:
+        _config.set_latency_crossover_bytes(crossover)
+    return {
+        "collective": "allreduce",
+        "nranks": n,
+        "dtype": str(jnp.dtype(dtype)),
+        "platform": platform,
+        "cache_file": cache_path(),
+        "tuned_from_cache": True,
+        "from_disk": from_disk,
+        "entries": cached,
+        "crossover_bytes": crossover,
+    }
+
+
+def _main(argv: Iterable[str]) -> int:
+    smoke = "--smoke" in argv
+    sizes = SMOKE_SIZES if smoke else DEFAULT_SIZES
+    report = ensure_tuned_allreduce(sizes=sizes,
+                                    iters=2 if smoke else 5)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

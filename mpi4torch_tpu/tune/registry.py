@@ -1,0 +1,178 @@
+"""Collective-algorithm registry.
+
+Production collective stacks select the wire *algorithm* per message
+size and topology (GC3, arXiv:2201.11840; "The Big Send-off",
+arXiv:2504.18658): a bandwidth-optimal schedule for large payloads, a
+latency-optimal one for the small control tensors (loss scalars, norms,
+MoE router counts) that pay ``O(nranks)`` ring steps for a few bytes.
+This registry names the schedules the SPMD backend can emit
+(ops/spmd.py) and their applicability constraints; the selector
+(:mod:`mpi4torch_tpu.tune`) and the persistent autotuner
+(:mod:`.autotuner`) choose among them.
+
+Shipped algorithms (wire accounting for payload S over N ranks):
+
+=========  ===========================================  ==============
+name       schedule                                      regime
+=========  ===========================================  ==============
+``ring``   ``lax.psum`` — XLA's bandwidth-optimal ring   large payloads
+           (reduce-scatter + all-gather,                 (default)
+           2·S·(N-1)/N on the wire, ~2(N-1) hops)
+``rhd``    recursive halving/doubling butterfly:         small payloads,
+           2·log2(N) ``collective_permute`` hops of      power-of-two N
+           halving/doubling width — latency-optimal,
+           same 2·S·(N-1)/N wire
+``tree``   binomial reduce-to-root + tree broadcast:     small payloads,
+           2·ceil(log2 N) full-payload hops — the        any N
+           non-power-of-two latency fallback
+``hier``   2-level hierarchical: intra-group             2D meshes /
+           reduce-scatter → inter-group allreduce →      grouped
+           intra-group all-gather, groups from the       topologies
+           mesh axis sizes (``comm_from_mesh``) or a
+           divisor of N
+=========  ===========================================  ==============
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmSpec:
+    """A registered collective algorithm and its applicability rules.
+
+    ``latency_optimal`` marks the algorithms the selector prefers below
+    the measured latency/bandwidth crossover.  Applicability is static
+    (rank-count shape), so selection is a pure function of the call
+    signature plus the autotuner cache — deterministic per jit cache
+    key."""
+
+    name: str
+    collectives: Tuple[str, ...] = ("allreduce",)
+    latency_optimal: bool = False
+    requires_power_of_two: bool = False
+    requires_factorable: bool = False
+    description: str = ""
+
+    def applicable(self, nranks: int,
+                   collective: str = "allreduce") -> bool:
+        if collective not in self.collectives:
+            return False
+        if nranks <= 1:
+            # A one-rank collective is the identity; every schedule
+            # degenerates, so only the default needs to claim it.
+            return self.name == "ring"
+        if self.requires_power_of_two and (nranks & (nranks - 1)):
+            return False
+        if self.requires_factorable and best_group(nranks) is None:
+            return False
+        return True
+
+    def why_not(self, nranks: int,
+                collective: str = "allreduce") -> Optional[str]:
+        """Human reason this algorithm cannot serve the call, or None."""
+        if collective not in self.collectives:
+            return (f"algorithm {self.name!r} serves "
+                    f"{'/'.join(self.collectives)}, not {collective}")
+        if nranks > 1 and self.requires_power_of_two \
+                and (nranks & (nranks - 1)):
+            return (f"algorithm {self.name!r} (recursive halving/"
+                    f"doubling) needs a power-of-two world; got "
+                    f"{nranks} ranks — use 'tree' for the logarithmic "
+                    "schedule at this size, or 'ring'")
+        if nranks > 1 and self.requires_factorable \
+                and best_group(nranks) is None:
+            return (f"algorithm {self.name!r} needs a 2-level group "
+                    f"factorization of the world size; {nranks} has no "
+                    "nontrivial divisor")
+        return None
+
+
+def best_group(n: int) -> Optional[int]:
+    """Default intra-group size for the 2-level ``hier`` schedule on a
+    flat axis of ``n`` ranks: the divisor closest to ``sqrt(n)`` (ties
+    to the smaller — the intra tier is usually the faster one, so keep
+    groups tight), or None when ``n`` is prime or < 4."""
+    if n < 4:
+        return None
+    best, dist = None, None
+    for g in range(2, n):
+        if n % g:
+            continue
+        d = abs(g - n // g)
+        if dist is None or d < dist:
+            best, dist = g, d
+    return best
+
+
+_REGISTRY: Dict[str, AlgorithmSpec] = {}
+
+
+def register_algorithm(spec: AlgorithmSpec) -> AlgorithmSpec:
+    """Register an algorithm spec under ``spec.name`` (the selector and
+    the ``algorithm=`` facade argument accept it immediately).  The
+    schedule itself must be known to the backend — this registry names
+    and gates, it does not carry lowering code."""
+    if not spec.name:
+        raise ValueError("algorithm must have a non-empty name")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def available_algorithms() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def get_algorithm(spec) -> AlgorithmSpec:
+    """Resolve an ``algorithm=`` argument to its spec; raises on names
+    the registry does not know (catching typos at the facade instead of
+    deep inside a trace)."""
+    if isinstance(spec, AlgorithmSpec):
+        return spec
+    if isinstance(spec, str):
+        got = _REGISTRY.get(spec)
+        if got is None:
+            raise ValueError(
+                f"unknown collective algorithm {spec!r}; available: "
+                f"{', '.join(available_algorithms())}")
+        return got
+    raise TypeError(
+        f"algorithm must be a registered name or an AlgorithmSpec; "
+        f"got {spec!r}")
+
+
+register_algorithm(AlgorithmSpec(
+    name="ring",
+    collectives=("allreduce", "reduce", "bcast"),
+    description="XLA-native bandwidth-optimal ring (lax.psum / masked "
+                "psum); ~2(N-1) pipelined hops, 2·S·(N-1)/N wire",
+))
+register_algorithm(AlgorithmSpec(
+    name="rhd",
+    collectives=("allreduce",),
+    latency_optimal=True,
+    requires_power_of_two=True,
+    description="recursive halving/doubling butterfly: 2·log2(N) "
+                "collective_permute hops of halving width — "
+                "latency-optimal allreduce for power-of-two worlds",
+))
+register_algorithm(AlgorithmSpec(
+    name="tree",
+    collectives=("allreduce", "reduce", "bcast"),
+    latency_optimal=True,
+    description="binomial reduce-to-root + tree broadcast: "
+                "2·ceil(log2 N) full-payload hops; the any-N "
+                "logarithmic schedule",
+))
+register_algorithm(AlgorithmSpec(
+    name="hier",
+    collectives=("allreduce",),
+    requires_factorable=True,
+    description="2-level hierarchical allreduce: intra-group "
+                "reduce-scatter → inter-group allreduce → intra-group "
+                "all-gather; groups from mesh axis sizes or a divisor "
+                "of N",
+))

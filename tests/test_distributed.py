@@ -151,7 +151,6 @@ _HYBRID_WORKER = textwrap.dedent("""
     # compared BIT FOR BIT against deterministic-mode results computed on
     # the real 2-process mesh, on both ordered-fold lowerings (gather
     # fold and the chunked ring fold).
-    from mpi4torch_tpu.ops import spmd as _spmd
     data = np.stack([np.sin(np.arange(513, dtype=np.float32) * (r + 1))
                      for r in range(8)]).astype(np.float32)
     datj = jnp.asarray(data)
@@ -168,8 +167,8 @@ _HYBRID_WORKER = textwrap.dedent("""
 
     for fold in ("gather", "ring"):
         if fold == "ring":
-            _spmd._ORDERED_FOLD_GATHER_MAX_BYTES = 0
-            _spmd._ORDERED_RING_CHUNK_BYTES = 256
+            mpi.config.set_ordered_fold_gather_max_bytes(0)
+            mpi.config.set_ordered_ring_chunk_bytes(256)
         with mpi.config.deterministic_mode(True):
             out = mpi.run_spmd(det_body)()     # global mesh, both procs
         ranks, vals = mpi.local_values(out)

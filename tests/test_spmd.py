@@ -101,8 +101,9 @@ class TestAllreduceSpmd:
 
         with mpi.config.deterministic_mode(True):
             gather_path = np.asarray(run(spmd_fn)(data))
-            monkeypatch.setattr(spmd_mod, "_ORDERED_FOLD_GATHER_MAX_BYTES", 0)
-            monkeypatch.setattr(spmd_mod, "_ORDERED_RING_CHUNK_BYTES", 64)
+            monkeypatch.setattr(mpi.config,
+                                "_ordered_fold_gather_max_bytes", 0)
+            monkeypatch.setattr(mpi.config, "_ordered_ring_chunk_bytes", 64)
             ring_path = np.asarray(run(spmd_fn)(data))
 
         np.testing.assert_array_equal(ring_path, gather_path)
@@ -128,9 +129,10 @@ class TestAllreduceSpmd:
 
         with mpi.config.deterministic_mode(True):
             want = np.asarray(run(spmd_fn)(data))
-            monkeypatch.setattr(spmd_mod, "_ORDERED_FOLD_GATHER_MAX_BYTES", 0)
+            monkeypatch.setattr(mpi.config,
+                                "_ordered_fold_gather_max_bytes", 0)
             for chunk_bytes in (64 * 4, 16 * 4):   # 1 chunk; 4 exact chunks
-                monkeypatch.setattr(spmd_mod, "_ORDERED_RING_CHUNK_BYTES",
+                monkeypatch.setattr(mpi.config, "_ordered_ring_chunk_bytes",
                                     chunk_bytes)
                 got = np.asarray(run(spmd_fn)(data))
                 np.testing.assert_array_equal(got, want)
@@ -162,12 +164,12 @@ class TestAllreduceSpmd:
             with mpi.config.deterministic_mode(True):
                 want = np.asarray(run(spmd_fn)(data))
                 monkeypatch.setattr(
-                    spmd_mod, "_ORDERED_FOLD_GATHER_MAX_BYTES", 0)
+                    mpi.config, "_ordered_fold_gather_max_bytes", 0)
                 monkeypatch.setattr(
-                    spmd_mod, "_ORDERED_RING_CHUNK_BYTES", chunk_bytes)
+                    mpi.config, "_ordered_ring_chunk_bytes", chunk_bytes)
                 got = np.asarray(run(spmd_fn)(data))
                 monkeypatch.setattr(
-                    spmd_mod, "_ORDERED_FOLD_GATHER_MAX_BYTES",
+                    mpi.config, "_ordered_fold_gather_max_bytes",
                     4 * 1024 * 1024)
             np.testing.assert_array_equal(got, want, err_msg=str(
                 (shape, axis, chunk_bytes)))

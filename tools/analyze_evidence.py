@@ -4,7 +4,7 @@
 Run after tools/tpu_evidence.sh completes: parses the bench JSON (last
 line of 02_bench.log) and the tradeoffs JSON (03_tradeoffs.log), prints
 a judge-facing summary plus concrete constant recommendations —
-measured crossovers for ``_BCAST_TREE_MAX_BYTES`` (ops/spmd.py),
+measured crossovers for ``config.bcast_tree_max_bytes``,
 the best flash tile config (``_Q_TILE``/``_KV_TILE``, ops/flash.py),
 and the best CE chunk width (bench.py train config).  Read-only: the
 human applies (and cites) the numbers.
@@ -83,7 +83,7 @@ def main():
                    if p.get("tree_s") and p.get("psum_s")
                    and p["tree_s"] < p["psum_s"]]
             print(f"bcast: tree wins at bytes={win} -> "
-                  f"_BCAST_TREE_MAX_BYTES ~ {max(win) if win else 0}")
+                  f"config.bcast_tree_max_bytes ~ {max(win) if win else 0}")
         ft = tro.get("flash_tiling")
         if isinstance(ft, list):
             ok = [p for p in ft if p.get("fwd_bwd_s")]
