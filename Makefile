@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -27,13 +27,27 @@ tier1:
 		| tr -cd . | wc -c); exit $$rc
 
 # CPU smoke run of the allreduce-algorithm autotuner sweep
-# (mpi4torch_tpu.tune): measures ring/rhd/tree/hier at three small
-# sizes, persists winners to the JSON cache, prints the report.  Run it
-# twice to see `"tuned_from_cache": true` on the second pass.
+# (mpi4torch_tpu.tune): measures every registered algorithm —
+# ring/rhd/tree/hier plus the bandwidth tier bidir/torus — at three
+# small sizes on the 8-virtual-device CPU mesh, persists winners to the
+# JSON cache, prints the report.  Run it twice to see
+# `"tuned_from_cache": true` on the second pass; inspect the cached
+# winners with `python -m mpi4torch_tpu.tune --show`.
 tune-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.tune.autotuner --smoke
+
+# Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
+# sizes × algorithms GB/s table + measured latency/bandwidth
+# crossovers), no model benches.  Runs on whatever accelerator is
+# attached; always re-measures (winners persist, so it doubles as a
+# tuning run).  Smoke variant on the 8-virtual-device CPU mesh (the
+# device-count flag matters: a 1-device world can only run `ring`):
+#   make bench-sweep SWEEP_FLAGS=--smoke JAX_PLATFORMS=cpu \
+#     XLA_FLAGS=--xla_force_host_platform_device_count=8
+bench-sweep:
+	python -m mpi4torch_tpu.tune.autotuner --sweep $(SWEEP_FLAGS)
 
 # Hardware-gated subset: requires a real TPU.  The escape hatch opens the
 # conftest platform gate (which otherwise pins cpu, regardless of any

@@ -404,11 +404,12 @@ def _bench_allreduce_fused(on_tpu: bool):
 
 
 def _bench_allreduce_algorithms(on_tpu: bool):
-    """Per-algorithm allreduce size sweep (mpi4torch_tpu.tune, ISSUE 3):
+    """Per-algorithm allreduce size sweep (mpi4torch_tpu.tune):
     1 KiB → 64 MiB on hardware (three points on the CPU smoke path),
-    per-algorithm GB/s under ring-allreduce wire accounting, the
-    measured ring/latency crossover, and the persistent autotuner's
-    picks.  The autotuner stanza round-trips its JSON cache: the first
+    per-algorithm GB/s under ring-allreduce wire accounting for every
+    registered algorithm — the latency tier (rhd/tree), hier, and the
+    multipath bandwidth tier (bidir/torus) — the measured latency AND
+    bandwidth crossovers, and the persistent autotuner's picks.  The autotuner stanza round-trips its JSON cache: the first
     bench run measures and persists, a second run reports
     ``tuned_from_cache: true`` with the same picks and zero tuning
     overhead — the ISSUE 3 acceptance evidence."""
@@ -447,18 +448,34 @@ def _bench_allreduce_algorithms(on_tpu: bool):
     # applied, so the next process (and the next bench run) selects
     # tuned algorithms without measuring.
     rep = tune.autotune_allreduce(sizes=sizes, nranks=n, iters=iters)
+
+    # The flat sweep table (sizes × algorithms → GB/s) — algorithm-
+    # selection quality tracked across rounds (BENCH_r*.json): every
+    # registered algorithm, including the bandwidth tier bidir/torus,
+    # shows its measured throughput next to the winner column.
+    sweep = {}
+    for size_str, ent in rep["entries"].items():
+        sweep[size_str] = {
+            name: meas.get("gbps", meas.get("error"))
+            for name, meas in ent.get("algorithms", {}).items()}
     out = {
         "n_devices": n,
         "dtype": rep["dtype"],
+        "algorithms": list(tune.available_algorithms()),
         "sizes": rep["entries"],
+        "sweep_gbps": sweep,
         # The crossover table's headline: the largest size where a
-        # latency-optimal schedule still beats the ring (None = ring
-        # wins everywhere measured — the latency regime not reached).
+        # latency-optimal schedule still beats the ring, and the
+        # smallest from which the multipath bandwidth tier wins through
+        # the top (None = that regime not reached on this hardware).
         "crossover_bytes": rep["crossover_bytes"],
+        "bandwidth_crossover_bytes": rep["bandwidth_crossover_bytes"],
         "autotuner": {
             "tuned_from_cache": bool(had_disk is True),
             "cache_file": rep["cache_file"],
             "crossover_bytes": rep["crossover_bytes"],
+            "bandwidth_crossover_bytes":
+                rep["bandwidth_crossover_bytes"],
             "picks": {k: v.get("winner")
                       for k, v in rep["entries"].items()},
         },

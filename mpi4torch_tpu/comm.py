@@ -255,10 +255,15 @@ class MPI_Communicator:
 
         ``algorithm`` selects the wire schedule
         (:mod:`mpi4torch_tpu.tune`: ``"ring"``, ``"rhd"``, ``"tree"``,
-        ``"hier"``, or ``False``/``"auto"`` to override an active
+        ``"hier"``, the bandwidth tier ``"bidir"``/``"torus"``, or
+        ``False``/``"auto"`` to override an active
         ``algorithm_scope``); ``None`` defers to the scope/process
-        default, which defers to the autotuner-backed selector.  The
-        backward pass uses the matching algorithm.  Codecs declare
+        default, which defers to the autotuner-backed selector (three
+        tiers: latency algorithms below the measured crossover, ring in
+        the middle, multipath at/above the measured bandwidth
+        crossover).  The backward pass uses the matching algorithm —
+        ``bidir``'s backward rides the same dual-ring machinery with
+        the channel directions swapped.  Codecs declare
         which algorithms they compose with (``q8`` is ring-only): an
         explicit algorithm + explicit codec that do not compose raise;
         with only one of them explicit, the scope-provided half

@@ -303,6 +303,55 @@ def set_latency_crossover_bytes(nbytes) -> None:
         else _validated_threshold(nbytes, "latency_crossover_bytes"))
 
 
+# Measured ring/multipath crossover for allreduce algorithm selection —
+# the upper edge of the three-tier auto selection (latency algorithms
+# below latency_crossover_bytes, plain ring in the middle, a multipath
+# bandwidth algorithm at/above this).  None = not measured: like the
+# latency crossover, auto-selection deviates from `ring` only on
+# evidence.
+_bandwidth_crossover_bytes = None
+
+
+def bandwidth_crossover_bytes():
+    """Payload-bytes floor at/above which the tune selector prefers a
+    bandwidth-tier multipath algorithm (``bidir``, the dual-ring) for
+    auto-selected allreduces.  ``None`` (default) = unmeasured: auto
+    selection stays on ``ring`` for large payloads except where the
+    autotuner cache names a winner.  Set from measurement by
+    :func:`mpi4torch_tpu.tune.autotune_allreduce` or explicitly here."""
+    return _bandwidth_crossover_bytes
+
+
+def set_bandwidth_crossover_bytes(nbytes) -> None:
+    global _bandwidth_crossover_bytes
+    _bandwidth_crossover_bytes = (
+        None if nbytes is None
+        else _validated_threshold(nbytes, "bandwidth_crossover_bytes"))
+
+
+# Phase pipelining of the deterministic chunked ring fold (ops/spmd.py
+# _ring_fold_allreduce): when True (default) a chunk whose ascending-rank
+# fold has completed starts its all-gather relay around the ring while
+# later chunks are still folding — one fused scan, no trailing
+# full-payload broadcast barrier.  False restores the fold-then-tree-
+# broadcast two-phase form (the pre-pipelining baseline, kept for
+# head-to-head measurement).  Bits are identical either way: the fold
+# association is untouched and the relay is pure data movement.
+_phase_pipelined_ring = True
+
+
+def phase_pipelined_ring() -> bool:
+    """Whether the deterministic chunked ring fold overlaps its
+    all-gather head with the reduce-scatter tail (see ops/spmd.py
+    ``_ring_fold_allreduce``)."""
+    return _phase_pipelined_ring
+
+
+def set_phase_pipelined_ring(value: bool) -> None:
+    global _phase_pipelined_ring
+    _phase_pipelined_ring = bool(value)
+
+
 # Intra-group size of the 2-level `hier` allreduce on a single mesh axis.
 # None = derive: the minor axis extent when the communicator was adopted
 # from a multi-axis mesh, else the divisor of nranks closest to sqrt.
@@ -332,6 +381,7 @@ def thresholds_fingerprint():
     instead of silently reusing the old lowering."""
     return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
             _bcast_tree_max_bytes, _latency_crossover_bytes,
+            _bandwidth_crossover_bytes, _phase_pipelined_ring,
             _hier_group_size)
 
 

@@ -200,6 +200,56 @@ def reduce_grouped(op, values, group: int):
     return reduce_ordered(op, partials)
 
 
+def multipath_split(total: int) -> int:
+    """THE split point of a multipath payload: the first ``multipath_split``
+    flat elements ride channel 0, the rest channel 1.  One shared rule for
+    the SPMD ``bidir``/``torus`` schedules (ops/spmd.py) and the eager
+    folds below, so Mode A and Mode B can never disagree about which
+    element belongs to which channel."""
+    return -(-int(total) // 2)
+
+
+def reduce_torus(op, values, inner: int):
+    """Reduce per-rank tensors in the 2-axis torus multipath association
+    (the SPMD ``torus`` schedule, ops/spmd.py): ranks form a row-major
+    ``(outer, inner)`` grid, the flat payload splits at
+    :func:`multipath_split`, and each half folds in the 2-level grouped
+    association of its own channel —
+
+    * **half 0** (inner-axis channel): ascending fold within each block
+      of ``inner`` consecutive ranks, then ascending over the block
+      partials (exactly :func:`reduce_grouped`);
+    * **half 1** (outer-axis channel): ascending fold within each
+      outer-axis group ``{i, i+inner, i+2·inner, …}``, then ascending
+      over the per-column partials — the same grouped fold on the
+      transposed grid.
+
+    Bit-identical to the deterministic form of the compiled schedule on
+    both the flat-axis (``axis_index_groups``) and the two-axis
+    (``comm_from_mesh(mesh, (outer, inner))``) communicator."""
+    vals = list(values)
+    n = len(vals)
+    if inner < 1 or n % inner:
+        raise ValueError(
+            f"reduce_torus needs inner ({inner}) to divide the rank "
+            f"count ({n})")
+    outer = n // inner
+    shape = vals[0].shape
+    flats = [v.reshape(-1) for v in vals]
+    total = flats[0].size
+    m = multipath_split(total)
+    h0 = reduce_grouped(op, [f[:m] for f in flats], inner)
+    if m >= total:
+        return h0.reshape(shape)
+    # Column-major rank order: consecutive runs of the transposed list
+    # are the outer-axis groups, so one grouped fold serves both halves.
+    perm = [o * inner + i for i in range(inner) for o in range(outer)]
+    h1 = reduce_grouped(op, [flats[p][m:] for p in perm], outer)
+    import numpy as _np
+    xp = _np if isinstance(h0, _np.ndarray) else jnp
+    return xp.concatenate([h0, h1]).reshape(shape)
+
+
 # Below this element count the N-1 jnp folds beat the host round-trip of
 # the native kernel.  Measured (bench_tradeoffs.py native_reduce_crossover,
 # 8 f32 buffers, round-5 single-core host): native/jnp seconds were

@@ -204,11 +204,13 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     (:mod:`mpi4torch_tpu.tune`), applied *per bucket*: an explicit name
     pins every bucket; with auto selection the tune selector picks per
     bucket size, so the full body buckets keep the ring
-    reduce-scatter/all-gather pair while a small tail bucket — below
-    the measured latency crossover — takes the latency-optimal
-    schedule (``rhd``/``tree``) instead of paying O(nranks) ring steps
-    for a few KiB.  Compressed buckets stay on the algorithms their
-    codec declares (``q8`` → ring)."""
+    reduce-scatter/all-gather pair — or, past the measured
+    ``config.bandwidth_crossover_bytes``, the multipath bandwidth
+    algorithm (``bidir``'s counter-rotating dual ring) — while a small
+    tail bucket below the measured latency crossover takes the
+    latency-optimal schedule (``rhd``/``tree``) instead of paying
+    O(nranks) ring steps for a few KiB.  Compressed buckets stay on the
+    algorithms their codec declares (``q8`` → ring)."""
     if mean and op != C.MPI_SUM:
         raise CommError(
             f"mean=True is the rank-mean of an MPI_SUM reduction; got "
@@ -318,9 +320,9 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
             # that does not divide THIS communicator degrades hier to
             # ring.
             if owns_resolution:
-                if balgo not in (None, "ring", "hier"):
+                if balgo not in (None, "ring", "hier", "torus"):
                     balgo = None
-            elif balgo == "hier":
+            elif balgo in ("hier", "torus"):
                 from ..tune import resolve_hier_group
                 try:
                     resolve_hier_group(size)

@@ -124,6 +124,25 @@ def _rendezvous_fold(world_size: int, algorithm,
                 return ring
             raise
         return "hier", lambda op, vals: C.reduce_grouped(op, vals, g)
+    if algorithm == "bidir":
+        # The dual-ring halves are disjoint element ranges of an
+        # ELEMENTWISE fold, so bidir's deterministic association is the
+        # plain ascending-rank oracle (ops/spmd.py
+        # _bidir_allreduce_value, deterministic branch) — the ring fold.
+        return "bidir", C.reduce_ordered
+    if algorithm == "torus":
+        # Same 2-level group rule as hier; the fold stripes the payload
+        # across the two tiers (constants.reduce_torus), matching the
+        # deterministic form of BOTH compiled torus lowerings — the
+        # flat-axis virtual torus and the 2-axis mesh communicator.
+        from ..tune import resolve_hier_group
+        try:
+            g = resolve_hier_group(world_size)
+        except CommError:
+            if not explicit:
+                return ring
+            raise
+        return "torus", lambda op, vals: C.reduce_torus(op, vals, g)
     raise CommError(
         f"unknown collective algorithm {algorithm!r} for the eager "
         "backend")

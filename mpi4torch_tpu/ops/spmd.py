@@ -376,13 +376,24 @@ def _ring_fold_allreduce(ctx: SpmdContext, x, op: int):
 
     Chunk ``j`` rides the ring 0→1→…→size-1, each hop adding that rank's
     contribution on the right of the fold (``combine2(acc, mine)``, the
-    exact association of the gather fold); chunks pipeline one step apart,
-    so the fold finishes in size+nchunks-1 ``collective_permute`` steps
-    under one ``lax.scan`` (O(1) compiled program).  The completed fold
-    lands on the last rank and returns to all ranks via the binomial-tree
-    broadcast — pure data movement (permute + select), so no reduction
-    reorder can perturb bits (the masked-psum broadcast could flip the sign
-    of -0.0; the tree cannot)."""
+    exact association of the gather fold); chunks pipeline one step apart
+    under one ``lax.scan`` (O(1) compiled program).
+
+    **Phase pipelining** (``config.phase_pipelined_ring()``, default on):
+    a chunk whose fold completed on the last rank starts its all-gather
+    relay around the same ring IMMEDIATELY — while later chunks are
+    still folding — so the reduce-scatter tail and the all-gather head
+    overlap chunk-wise inside one fused scan of ``nchunks + 2(size-1)``
+    steps with two chunk-sized permutes per step, and the trailing
+    full-payload tree-broadcast barrier (``ceil(log2 size)`` sequential
+    whole-tensor hops ≈ ``nchunks·log2(size)`` chunk-times of wire on
+    top of the fold) disappears entirely.  With the knob off, the
+    two-phase baseline runs: the fold scan, then the binomial-tree
+    broadcast from the last rank.  Both forms fold in the identical
+    ascending-rank association and move completed chunks by pure data
+    movement (permute + select), so the bits are identical either way
+    (the masked-psum broadcast could flip the sign of -0.0; neither the
+    tree nor the relay can)."""
     n = ctx.size
     idx = lax.axis_index(ctx.axis_name)
     shape, dtype = x.shape, x.dtype
@@ -396,29 +407,80 @@ def _ring_fold_allreduce(ctx: SpmdContext, x, op: int):
         flat = jnp.concatenate(
             [flat, jnp.zeros(padded - total, dtype)])
     xc = flat.reshape(nchunks, chunk_elems)
-
-    nsteps = n + nchunks - 1
     ring = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, t):
-        prev, out = carry
-        recv = lax.ppermute(prev, ctx.axis_name, perm=ring)
+    if not _config.phase_pipelined_ring():
+        # Two-phase baseline: fold every chunk (size+nchunks-1 steps),
+        # then one full-payload tree broadcast from the last rank.
+        nsteps = n + nchunks - 1
+
+        def step(carry, t):
+            prev, out = carry
+            recv = lax.ppermute(prev, ctx.axis_name, perm=ring)
+            j = t - idx
+            active = (j >= 0) & (j < nchunks)
+            jc = jnp.clip(j, 0, nchunks - 1)
+            mine = lax.dynamic_index_in_dim(xc, jc, axis=0, keepdims=False)
+            acc = jnp.where(idx == 0, mine, C.combine2(op, recv, mine))
+            row = lax.dynamic_index_in_dim(out, jc, axis=0, keepdims=False)
+            store = active & (idx == n - 1)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(store, acc, row), jc, axis=0)
+            nxt = jnp.where(active, acc, prev)
+            return (nxt, out), None
+
+        init = (jnp.zeros(chunk_elems, dtype), jnp.zeros_like(xc))
+        (_, folded), _ = lax.scan(step, init, jnp.arange(nsteps))
+        result = _tree_bcast_value(ctx, folded.reshape(-1), n - 1)
+        return result[:total].reshape(shape)
+
+    # Phase-pipelined form: fold lane (identical schedule and bits to
+    # the baseline) + relay lane — chunk j, completed on rank n-1 at
+    # step j+n-1, is injected into the relay and rides the +1 ring;
+    # rank r (relay distance hops = (r+1) % n from the last rank)
+    # receives it at step j + n-1 + hops, stores it, and forwards it
+    # (rank n-2, the final receiver, stops the loop).  Chunks arrive
+    # one step apart, so a single relay slot suffices.
+    nsteps = nchunks + 2 * (n - 1)
+    hops = (idx + 1) % n
+
+    def pstep(carry, t):
+        fold_prev, relay_prev, out = carry
+        fold_recv = lax.ppermute(fold_prev, ctx.axis_name, perm=ring)
+        relay_recv = lax.ppermute(relay_prev, ctx.axis_name, perm=ring)
+
+        # Fold lane (baseline association, untouched).
         j = t - idx
-        active = (j >= 0) & (j < nchunks)
+        active_f = (j >= 0) & (j < nchunks)
         jc = jnp.clip(j, 0, nchunks - 1)
         mine = lax.dynamic_index_in_dim(xc, jc, axis=0, keepdims=False)
-        acc = jnp.where(idx == 0, mine, C.combine2(op, recv, mine))
-        row = lax.dynamic_index_in_dim(out, jc, axis=0, keepdims=False)
-        store = active & (idx == n - 1)
-        out = lax.dynamic_update_index_in_dim(
-            out, jnp.where(store, acc, row), jc, axis=0)
-        nxt = jnp.where(active, acc, prev)
-        return (nxt, out), None
+        acc = jnp.where(idx == 0, mine, C.combine2(op, fold_recv, mine))
+        fold_next = jnp.where(active_f, acc, fold_prev)
 
-    init = (jnp.zeros(chunk_elems, dtype), jnp.zeros_like(xc))
-    (_, folded), _ = lax.scan(step, init, jnp.arange(nsteps))
-    result = _tree_bcast_value(ctx, folded.reshape(-1), n - 1)
-    return result[:total].reshape(shape)
+        # Relay lane: inject on completion (rank n-1), forward elsewhere.
+        land = active_f & (idx == n - 1)
+        jr = t - (n - 1) - hops
+        active_r = (jr >= 0) & (jr < nchunks) & (hops >= 1)
+        jrc = jnp.clip(jr, 0, nchunks - 1)
+        relay_next = jnp.where(
+            land, acc,
+            jnp.where(active_r & (idx != n - 2), relay_recv, relay_prev))
+
+        # Store: the landing rank keeps its completed chunk, every other
+        # rank the relayed one — mutually exclusive (hops >= 1 excludes
+        # rank n-1 from active_r), so one store slot per step.
+        do_store = land | active_r
+        loc = jnp.where(land, jc, jrc)
+        val = jnp.where(land, acc, relay_recv)
+        row = lax.dynamic_index_in_dim(out, loc, axis=0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(do_store, val, row), loc, axis=0)
+        return (fold_next, relay_next, out), None
+
+    init = (jnp.zeros(chunk_elems, dtype), jnp.zeros(chunk_elems, dtype),
+            jnp.zeros_like(xc))
+    (_, _, gathered), _ = lax.scan(pstep, init, jnp.arange(nsteps))
+    return gathered.reshape(-1)[:total].reshape(shape)
 
 
 def _ring_fold_reduce_scatter(ctx: SpmdContext, x, op: int, ax: int,
@@ -720,6 +782,183 @@ def _hier_allreduce_value(ctx: SpmdContext, x, op: int):
                                  (axis, outer))
 
 
+# ---------------------------------------------------------------------------
+# Bandwidth tier (mpi4torch_tpu.tune `bidir`/`torus`): multipath
+# schedules that stripe the payload across independent communication
+# channels — the two directions of a bidirectional link (`bidir`) or the
+# axes of a 2-level factorization (`torus`) — so the large-payload
+# regime reaches the wire bandwidth a single unidirectional ring leaves
+# on the table ("The Big Send-off", arXiv:2504.18658; GC3,
+# arXiv:2201.11840).  The channel split point is shared with the eager
+# folds (constants.multipath_split), keeping Mode A / Mode B
+# bit-comparable per algorithm under deterministic_mode.
+# ---------------------------------------------------------------------------
+
+
+# Worlds up to this size unroll the bidir chains hop-by-hop (distinct
+# permute ops, maximal scheduling freedom and the HLO-census surface);
+# larger worlds roll each phase into a lax.scan so the compiled program
+# does not grow with the rank count (a 256-rank pod would otherwise
+# emit ~1000 permute ops per bidir allreduce).
+_CHAIN_UNROLL_MAX = 32
+
+
+def _ring_allreduce_chain(ctx: SpmdContext, flat, op: int, direction: int):
+    """One explicit directional ring allreduce over ``collective_permute``:
+    reduce-scatter (N-1 hops) + all-gather (N-1 hops) on the ring
+    ``i -> (i + direction) % N``, payload split into N segments.
+
+    This is the building block of the ``bidir`` dual-ring: two chains of
+    opposite ``direction`` share no values, so XLA schedules their
+    permutes concurrently — each rides its own direction of the
+    bidirectional ICI link, with no serialization barrier between the
+    chains.  Segment ``j`` folds cyclically from rank ``j`` onward in
+    ring order (``combine2(partial, mine)`` per hop), completing at rank
+    ``(j - direction) % N``; the all-gather then relays completed
+    segments ``N-1`` more hops.  Returns the unpadded flat result.
+
+    Small worlds unroll the 2(N-1) hops (each permute a distinct HLO op
+    — the census surface); past ``_CHAIN_UNROLL_MAX`` ranks each phase
+    rolls into a ``lax.scan`` so the compiled program stays O(1) in the
+    world size (the wire schedule is identical — one chunk-sized
+    permute per step, same segment walk)."""
+    n = ctx.size
+    axis = ctx.axis_name
+    idx = lax.axis_index(axis)
+    total = flat.size
+    seg = -(-total // n)
+    if seg * n != total:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros(seg * n - total, flat.dtype)])
+    segs = flat.reshape(n, seg)
+    d = 1 if direction >= 0 else -1
+    perm = [(i, (i + d) % n) for i in range(n)]
+
+    # Reduce-scatter: at step t rank r forwards the partial of segment
+    # (r - d·t) % n and folds its own contribution into the arriving
+    # partial of segment (r - d·(t+1)) % n.
+    part = lax.dynamic_index_in_dim(segs, idx, axis=0, keepdims=False)
+
+    def rs_step(carry, t):
+        recv = lax.ppermute(carry, axis, perm=perm)
+        j = (idx - d * (t + 1)) % n
+        mine = lax.dynamic_index_in_dim(segs, j, axis=0, keepdims=False)
+        return C.combine2(op, recv, mine), None
+
+    if n <= _CHAIN_UNROLL_MAX:
+        for t in range(n - 1):
+            part, _ = rs_step(part, t)
+    else:
+        part, _ = lax.scan(rs_step, part, jnp.arange(n - 1))
+
+    # All-gather: rank r owns completed segment (r + d) % n; completed
+    # segments ride the same ring N-1 more hops.
+    out = jnp.zeros((n, seg), flat.dtype)
+    out = lax.dynamic_update_index_in_dim(out, part, (idx + d) % n, axis=0)
+
+    def ag_step(carry, t):
+        cur, acc = carry
+        cur = lax.ppermute(cur, axis, perm=perm)
+        acc = lax.dynamic_update_index_in_dim(
+            acc, cur, (idx - d * t) % n, axis=0)
+        return (cur, acc), None
+
+    if n <= _CHAIN_UNROLL_MAX:
+        carry = (part, out)
+        for t in range(n - 1):
+            carry, _ = ag_step(carry, t)
+        out = carry[1]
+    else:
+        (_, out), _ = lax.scan(ag_step, (part, out), jnp.arange(n - 1))
+    return out.reshape(-1)[:total]
+
+
+def _bidir_allreduce_value(ctx: SpmdContext, x, op: int,
+                           reverse: bool = False):
+    """Bidirectional dual-ring allreduce (``bidir``): the flat payload
+    splits at :func:`constants.multipath_split` into two halves that
+    ride counter-rotating :func:`_ring_allreduce_chain` chains
+    concurrently — two independent ``collective_permute`` chains, one
+    per link direction, ~2× link utilization on any world size.
+
+    ``reverse`` swaps the halves' directions: the adjoint of a ring
+    segment is a ring segment in the reverse direction, so the backward
+    pass reuses the forward machinery with swapped channels.
+
+    Under ``deterministic_reductions`` the halves are disjoint element
+    ranges of an ELEMENTWISE fold, so the deterministic association of
+    ``bidir`` is the plain ascending-rank oracle — the ordered fold
+    (bit-identical to ring's, and to the eager rendezvous fold for
+    ``algorithm="bidir"``); the cyclic per-segment associations of the
+    wire schedule are not rank-independent and are never used for
+    bit-exact results."""
+    n = ctx.size
+    if n == 1:
+        return x
+    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+        C.combine2(op, x, x)  # raises NotImplementedError with explanation
+    if _config.deterministic_reductions():
+        return _ordered_fold_allreduce(ctx, x, op)
+    shape = x.shape
+    flat = x.reshape(-1)
+    total = flat.size
+    m = C.multipath_split(total)
+    d0, d1 = (-1, 1) if reverse else (1, -1)
+    h0 = _ring_allreduce_chain(ctx, flat[:m], op, d0)
+    if m >= total:
+        return h0.reshape(shape)
+    h1 = _ring_allreduce_chain(ctx, flat[m:], op, d1)
+    return jnp.concatenate([h0, h1]).reshape(shape)
+
+
+def _torus_allreduce_value(ctx: SpmdContext, x, op: int):
+    """Multi-axis torus multipath allreduce (``torus``) on a flat axis:
+    the 2-level factorization of :func:`_hier_allreduce_value` (inner
+    tier of ``g`` consecutive ranks × outer tier of ``n/g`` groups,
+    ``tune.resolve_hier_group``) viewed as a virtual 2D torus, with the
+    payload STRIPED across the two axes instead of staged through one:
+    half 0 runs its grouped reduce-scatter → allreduce → all-gather
+    channel with the inner tier first, half 1 the same channel with the
+    tiers transposed — two concurrent channels whose first-stage
+    collectives ride different (virtual) axes.  The 2-axis mesh form
+    (:func:`_torus2d_fwd_value`) keys the channels off real mesh axes,
+    one ring channel per axis.
+
+    Deterministic / non-native ops fold each half in its channel's
+    fixed 2-level association — exactly
+    :func:`constants.reduce_torus`, the eager rendezvous fold."""
+    n = ctx.size
+    if n == 1:
+        return x
+    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+        C.combine2(op, x, x)  # raises NotImplementedError with explanation
+    axis = ctx.axis_name
+    g = _hier_group_for(ctx)
+    ngroups = n // g
+    inner = [[b * g + i for i in range(g)] for b in range(ngroups)]
+    outer = [[i + b * g for b in range(ngroups)] for i in range(g)]
+    shape = x.shape
+    flat = x.reshape(-1)
+    total = flat.size
+    m = C.multipath_split(total)
+    h0, h1 = flat[:m], flat[m:]
+    if op == C.MPI_SUM and not _config.deterministic_reductions():
+        o0 = _grouped_sum_schedule(h0, g, (axis, inner), (axis, outer),
+                                   (axis, inner))
+        o1 = (_grouped_sum_schedule(h1, ngroups, (axis, outer),
+                                    (axis, inner), (axis, outer))
+              if m < total else None)
+    else:
+        o0 = _grouped_ordered_fold(h0, op, g, ngroups, (axis, inner),
+                                   (axis, outer))
+        o1 = (_grouped_ordered_fold(h1, op, ngroups, g, (axis, outer),
+                                    (axis, inner))
+              if m < total else None)
+    if o1 is None:
+        return o0.reshape(shape)
+    return jnp.concatenate([o0, o1]).reshape(shape)
+
+
 def _allreduce_fwd_value(ctx: SpmdContext, x, op: int,
                          algorithm: str = "ring"):
     if algorithm == "rhd":
@@ -728,6 +967,10 @@ def _allreduce_fwd_value(ctx: SpmdContext, x, op: int,
         return _tree_allreduce_value(ctx, x, op)
     if algorithm == "hier":
         return _hier_allreduce_value(ctx, x, op)
+    if algorithm == "bidir":
+        return _bidir_allreduce_value(ctx, x, op)
+    if algorithm == "torus":
+        return _torus_allreduce_value(ctx, x, op)
     if op == C.MPI_SUM:
         if _config.deterministic_reductions():
             return _ordered_fold_allreduce(ctx, x, op)
@@ -742,6 +985,17 @@ def _allreduce_fwd_value(ctx: SpmdContext, x, op: int,
 
 
 
+def _allreduce_bwd_value(ctx: SpmdContext, g, algorithm: str):
+    """The SUM-allreduce adjoint on the matching algorithm.  ``bidir``
+    swaps its halves' ring directions — the adjoint of a ring segment is
+    a ring segment in the reverse direction, so the backward rides the
+    same multipath machinery with swapped channels; every other
+    algorithm's allreduce is self-adjoint as-is."""
+    if algorithm == "bidir":
+        return _bidir_allreduce_value(ctx, g, C.MPI_SUM, reverse=True)
+    return _allreduce_fwd_value(ctx, g, C.MPI_SUM, algorithm)
+
+
 def _bwd_scope(opname: str):
     """Named scope for collective adjoints so profiler traces show explicit
     *Backward spans — the reference's only observability surface is its
@@ -752,12 +1006,14 @@ def _bwd_scope(opname: str):
     return jax.named_scope(f"mpi4torch.{opname}Backward")
 
 def _auto_allreduce_algorithm(ctx: SpmdContext, x) -> str:
-    """Trace-time auto selection (mpi4torch_tpu.tune): the measured
-    cache winner for this (dtype, size-bucket, nranks, platform) key
-    when one exists, the measured latency crossover when the autotuner
-    has established one, else ``ring``.  Pure function of static call
-    data + the tune cache, and ``run_spmd`` keys its jit cache on the
-    cache generation, so selection can never silently diverge from a
+    """Trace-time auto selection (mpi4torch_tpu.tune), three tiers: the
+    measured cache winner for this (dtype, size-bucket, nranks,
+    platform) key when one exists; a latency algorithm (``rhd``/
+    ``tree``) below the measured latency crossover; the multipath
+    bandwidth tier (``bidir``) at/above the measured bandwidth
+    crossover; else ``ring``.  Pure function of static call data + the
+    tune cache, and ``run_spmd`` keys its jit cache on the cache
+    generation, so selection can never silently diverge from a
     compiled program."""
     from .. import tune as _tune
 
@@ -787,7 +1043,9 @@ def allreduce(ctx: SpmdContext, x, op: int, algorithm=None,
     explicit requests raise, scope defaults degrade to ``ring``."""
     if algorithm is None:
         algorithm = _auto_allreduce_algorithm(ctx, x)
-    if algorithm == "hier" and ctx.size > 1:
+    if algorithm in ("hier", "torus") and ctx.size > 1:
+        # Both 2-level schedules share the group rule
+        # (tune.resolve_hier_group) and its degrade/raise behavior.
         try:
             _hier_group_for(ctx)
         except CommError:
@@ -807,7 +1065,7 @@ def allreduce(ctx: SpmdContext, x, op: int, algorithm=None,
                 "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
             )
         with _bwd_scope("Allreduce"):
-            return (_allreduce_fwd_value(ctx, g, C.MPI_SUM, algorithm),)
+            return (_allreduce_bwd_value(ctx, g, algorithm),)
 
     f.defvjp(lambda v: (_allreduce_fwd_value(ctx, v, op, algorithm), None),
              bwd)
@@ -1445,11 +1703,51 @@ class HierMeshBackend:
         raise AttributeError(name)
 
 
+def _torus2d_fwd_value(hb: HierMeshBackend, x, op: int):
+    """The ``torus`` schedule on a real 2-axis mesh communicator: the
+    payload halves stripe across the two mesh axes — half 0's grouped
+    reduce-scatter/allreduce/all-gather channel leads with the inner
+    axis, half 1's with the outer axis — one concurrent ring channel
+    per axis, their first-stage collectives riding different ICI
+    dimensions with no dependency between the halves.  Deterministic /
+    non-native ops fold each half in its channel's fixed 2-level
+    association (:func:`constants.reduce_torus` with ``inner`` = the
+    inner axis extent — the eager oracle)."""
+    outer, inner = hb.axis_names
+    so, si = hb.axis_sizes
+    if so * si == 1:
+        return x
+    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+        C.combine2(op, x, x)  # raises with explanation
+    shape = x.shape
+    flat = x.reshape(-1)
+    total = flat.size
+    m = C.multipath_split(total)
+    h0, h1 = flat[:m], flat[m:]
+    if op == C.MPI_SUM and not _config.deterministic_reductions():
+        o0 = _grouped_sum_schedule(h0, si, (inner, None), (outer, None),
+                                   (inner, None))
+        o1 = (_grouped_sum_schedule(h1, so, (outer, None), (inner, None),
+                                    (outer, None))
+              if m < total else None)
+    else:
+        o0 = _grouped_ordered_fold(h0, op, si, so, (inner, None),
+                                   (outer, None))
+        o1 = (_grouped_ordered_fold(h1, op, so, si, (outer, None),
+                                    (inner, None))
+              if m < total else None)
+    if o1 is None:
+        return o0.reshape(shape)
+    return jnp.concatenate([o0, o1]).reshape(shape)
+
+
 def _hier2d_fwd_value(hb: HierMeshBackend, x, op: int, algorithm: str):
     outer, inner = hb.axis_names
     so, si = hb.axis_sizes
     if so * si == 1:
         return x
+    if algorithm == "torus":
+        return _torus2d_fwd_value(hb, x, op)
     det = _config.deterministic_reductions()
     if not det and op == C.MPI_SUM:
         if algorithm == "ring":
@@ -1476,19 +1774,29 @@ def hier_allreduce_2d(hb: HierMeshBackend, x, op: int, algorithm=None,
     the adjoint is the same 2-level collective on the cotangents.
 
     The facade's degrade/raise rule applies to algorithms this backend
-    cannot lower (``rhd``/``tree`` need a single axis): an explicit
-    request raises, a scope/process default yields to ``hier`` — the
-    communicator's own topology-native schedule."""
+    cannot lower (``rhd``/``tree``/``bidir`` need a single ring axis):
+    an explicit request raises, a scope/process default yields to
+    ``hier`` — the communicator's own topology-native schedule.  Auto
+    selection grows the bandwidth tier here too: at/above the measured
+    ``config.bandwidth_crossover_bytes`` (outside deterministic mode)
+    it picks ``torus`` — the per-axis multipath striping — instead of
+    the staged 2-level ``hier``."""
     if algorithm in (None, "auto"):
         algorithm = "hier"
-    if algorithm not in ("hier", "ring"):
+        bw = _config.bandwidth_crossover_bytes()
+        if bw is not None and not _config.deterministic_reductions():
+            xa = jnp.asarray(x)
+            if xa.size * xa.dtype.itemsize >= bw:
+                algorithm = "torus"
+    if algorithm not in ("hier", "ring", "torus"):
         if not explicit:
             algorithm = "hier"
         else:
             raise CommError(
                 f"a 2-axis mesh communicator lowers algorithm 'hier' "
-                f"(the 2-level schedule) or 'ring' (flat psum over both "
-                f"axes); got {algorithm!r} — rhd/tree need a "
+                f"(the staged 2-level schedule), 'torus' (per-axis "
+                f"multipath striping), or 'ring' (flat psum over both "
+                f"axes); got {algorithm!r} — rhd/tree/bidir need a "
                 "single-axis communicator")
 
     @jax.custom_vjp

@@ -1,20 +1,25 @@
 """Size- and topology-aware collective algorithm selection.
 
 The SPMD backend can emit several schedules for the same collective
-(``ring``/``rhd``/``tree``/``hier`` — see :mod:`.registry`); which one
-is fastest depends on message size, rank count, and topology.  This
-package decides:
+(``ring``/``rhd``/``tree``/``hier`` plus the multipath bandwidth tier
+``bidir``/``torus`` — see :mod:`.registry`); which one is fastest
+depends on message size, rank count, and topology.  This package
+decides:
 
 * **per call** — ``comm.Allreduce(x, op, algorithm="rhd")``;
 * **per scope** — ``with mpi.config.algorithm_scope("tree"): ...``
   (or process-wide via :func:`mpi4torch_tpu.config.set_default_algorithm`);
-* **by default** — the selector: the persisted autotuner cache's
-  measured winner for the ``(collective, dtype, nbytes-bucket, nranks,
-  platform)`` key when one exists, the measured latency crossover
-  (:func:`mpi4torch_tpu.config.latency_crossover_bytes`) when the
-  autotuner has established one, and ``ring`` otherwise — auto-selection
-  never deviates from the XLA-native ring on a guess, only on
-  measurement.
+* **by default** — the selector, in three tiers: the persisted
+  autotuner cache's measured winner for the ``(collective, dtype,
+  nbytes-bucket, nranks, platform)`` key when one exists; below the
+  measured latency crossover
+  (:func:`mpi4torch_tpu.config.latency_crossover_bytes`) the
+  latency-optimal algorithm (``rhd``/``tree``); at or above the
+  measured bandwidth crossover
+  (:func:`mpi4torch_tpu.config.bandwidth_crossover_bytes`) the
+  multipath bandwidth tier (``bidir``); and ``ring`` in between or when
+  nothing is measured — auto-selection never deviates from the
+  XLA-native ring on a guess, only on measurement.
 
 Degrade/raise rule (mirrors the compression scope's): a *scope or
 process default* that cannot legally serve a call — ``rhd`` on a
@@ -143,8 +148,10 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     Order: deterministic mode pins ``ring`` (the bit-exact ordered
     fold); a measured cache winner wins; below the measured latency
     crossover the latency-optimal algorithm wins (``rhd`` on
-    power-of-two worlds, else ``tree``); otherwise ``ring``.  A codec
-    restricts candidates to the algorithms it declares (``q8`` is
+    power-of-two worlds, else ``tree``); at or above the measured
+    bandwidth crossover the multipath bandwidth tier wins (``bidir``,
+    the dual-ring — applicable on any world); otherwise ``ring``.  A
+    codec restricts candidates to the algorithms it declares (``q8`` is
     ring-only)."""
     if nranks <= 1 or deterministic:
         return "ring"
@@ -152,7 +159,7 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     def ok(name: str) -> bool:
         if not get_algorithm(name).applicable(nranks, collective):
             return False
-        if name == "hier":
+        if name in ("hier", "torus"):
             # The registry gate is static (a nontrivial divisor
             # exists); a set config.hier_group_size can still void it
             # for THIS communicator — auto selection must never return
@@ -179,4 +186,11 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
             return "rhd"
         if ok("tree"):
             return "tree"
+    bandwidth = _config.bandwidth_crossover_bytes()
+    if bandwidth is not None and nbytes >= bandwidth:
+        # The third tier: multipath at/above the measured crossover.
+        # `bidir` is the any-world pick; `torus` wins only through a
+        # measured cache entry (its grouping quality is topology-bound).
+        if ok("bidir"):
+            return "bidir"
     return "ring"

@@ -105,15 +105,81 @@ def _load() -> None:
 
 
 def _save() -> None:
-    """Atomic, best-effort persist of the in-process table."""
+    """Atomic, concurrency-safe, best-effort persist of the in-process
+    table.
+
+    Two rules make simultaneous tuners (multi-host jobs, a bench next
+    to a training run) safe:
+
+    * the payload is written to a UNIQUE tempfile in the cache
+      directory (``tempfile.mkstemp`` — a fixed ``.tmp`` name would let
+      two processes interleave writes into the same staging file) and
+      ``os.replace``d over the cache, so readers only ever see a
+      complete JSON document;
+    * the read-merge-replace sequence runs under an exclusive
+      ``flock`` on a sidecar ``<cache>.lock`` file, and entries another
+      process persisted while we tuned are merged into the written
+      snapshot (disk keys we do not hold in memory) — concurrent tuning
+      work is unioned rather than lost to last-writer-wins, with no
+      lost-update window between the read and the replace.  On
+      filesystems without ``flock`` the lock is skipped (the merge
+      still narrows the race to the read→replace window; readers are
+      never blocked or torn either way)."""
+    import contextlib
+    import tempfile
+
     path = cache_path()
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": CACHE_VERSION, "entries": _mem}, f,
-                      indent=1, sort_keys=True)
-        os.replace(tmp, path)
+
+        @contextlib.contextmanager
+        def _locked():
+            try:
+                import fcntl
+                fd = os.open(path + ".lock",
+                             os.O_CREAT | os.O_RDWR, 0o644)
+            except (ImportError, OSError):
+                yield
+                return
+            try:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                except OSError:
+                    pass  # NFS & co: fall back to merge-only safety
+                yield
+            finally:
+                os.close(fd)
+
+        with _locked():
+            entries = dict(_mem)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    on_disk = json.load(f)
+                if (isinstance(on_disk, dict)
+                        and on_disk.get("version") == CACHE_VERSION
+                        and isinstance(on_disk.get("entries"), dict)):
+                    for key, ent in on_disk["entries"].items():
+                        if (isinstance(key, str) and isinstance(ent, dict)
+                                and isinstance(ent.get("algorithm"), str)
+                                and key not in entries):
+                            entries[key] = ent
+            except (OSError, ValueError):
+                pass
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path) or ".", prefix=".tune_cache.",
+                suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(
+                        {"version": CACHE_VERSION, "entries": entries},
+                        f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
     except OSError:
         pass
 
@@ -240,8 +306,11 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
                        apply_crossover: bool = True) -> dict:
     """Benchmark every applicable allreduce algorithm at each payload
     size, record the winners in the cache, and (by default) set
-    :func:`config.set_latency_crossover_bytes` from the measured
-    crossover so auto-selection reflects the measurement.
+    :func:`config.set_latency_crossover_bytes` AND
+    :func:`config.set_bandwidth_crossover_bytes` from the measured
+    crossovers so three-tier auto-selection (latency algorithms below,
+    ring in the middle, multipath ``bidir``/``torus`` above) reflects
+    the measurement.
 
     Returns the report dict (also the bench's JSON stanza):
     per-size per-algorithm seconds and GB/s, the winner table, the
@@ -307,6 +376,8 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
             "winner": winner,
             "winner_latency_optimal":
                 get_algorithm(winner).latency_optimal,
+            "winner_bandwidth_optimal":
+                get_algorithm(winner).bandwidth_optimal,
         }
 
     crossover = _crossover_from(report["entries"])
@@ -314,6 +385,11 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
     if apply_crossover and crossover is not None:
         _config.set_latency_crossover_bytes(crossover)
         report["applied_latency_crossover_bytes"] = crossover
+    bandwidth = _bandwidth_crossover_from(report["entries"])
+    report["bandwidth_crossover_bytes"] = bandwidth
+    if apply_crossover and bandwidth is not None:
+        _config.set_bandwidth_crossover_bytes(bandwidth)
+        report["applied_bandwidth_crossover_bytes"] = bandwidth
     return report
 
 
@@ -326,6 +402,24 @@ def _crossover_from(entries: dict) -> Optional[int]:
         if ent.get("winner_latency_optimal"):
             size = int(size_str)
             best = size if best is None else max(best, size)
+    return best
+
+
+def _bandwidth_crossover_from(entries: dict) -> Optional[int]:
+    """Smallest measured payload size from which a bandwidth-tier
+    multipath algorithm (``bidir``/``torus``) wins *at every larger
+    measured size too* — the ring/multipath crossover, the upper edge
+    of three-tier auto selection.  None when the largest measured size
+    is not won by the bandwidth tier (the multipath regime was not
+    reached, or a single noisy mid-size win must not flip steady-state
+    selection)."""
+    sized = sorted((int(s), ent) for s, ent in entries.items()
+                   if "winner" in ent)
+    best = None
+    for size, ent in reversed(sized):
+        if not ent.get("winner_bandwidth_optimal"):
+            break
+        best = size
     return best
 
 
@@ -364,11 +458,16 @@ def ensure_tuned_allreduce(sizes: Optional[Sequence[int]] = None,
             "winner": ent["algorithm"],
             "winner_latency_optimal":
                 get_algorithm(ent["algorithm"]).latency_optimal,
+            "winner_bandwidth_optimal":
+                get_algorithm(ent["algorithm"]).bandwidth_optimal,
             "measurements": ent.get("measurements"),
         }
     crossover = _crossover_from(cached)
     if apply_crossover and crossover is not None:
         _config.set_latency_crossover_bytes(crossover)
+    bandwidth = _bandwidth_crossover_from(cached)
+    if apply_crossover and bandwidth is not None:
+        _config.set_bandwidth_crossover_bytes(bandwidth)
     return {
         "collective": "allreduce",
         "nranks": n,
@@ -379,14 +478,21 @@ def ensure_tuned_allreduce(sizes: Optional[Sequence[int]] = None,
         "from_disk": from_disk,
         "entries": cached,
         "crossover_bytes": crossover,
+        "bandwidth_crossover_bytes": bandwidth,
     }
 
 
 def _main(argv: Iterable[str]) -> int:
     smoke = "--smoke" in argv
     sizes = SMOKE_SIZES if smoke else DEFAULT_SIZES
-    report = ensure_tuned_allreduce(sizes=sizes,
-                                    iters=2 if smoke else 5)
+    if "--sweep" in argv:
+        # The fast bench lane (`make bench-sweep`): ALWAYS measure —
+        # the point is a fresh sizes × algorithms throughput table
+        # (winners still persist, so it doubles as a tuning run).
+        report = autotune_allreduce(sizes=sizes, iters=2 if smoke else 5)
+    else:
+        report = ensure_tuned_allreduce(sizes=sizes,
+                                        iters=2 if smoke else 5)
     print(json.dumps(report))
     return 0
 

@@ -30,7 +30,23 @@ name       schedule                                      regime
            intra-group all-gather, groups from the       topologies
            mesh axis sizes (``comm_from_mesh``) or a
            divisor of N
+``bidir``  bidirectional dual-ring: payload halves       large payloads,
+           ride two counter-rotating                     bidirectional
+           ``collective_permute`` ring RS+AG chains      links (ICI)
+           concurrently — ~2× link utilization, same
+           per-half 2·(S/2)·(N-1)/N wire each way
+``torus``  multi-axis multipath: payload halves stripe   large payloads,
+           across the two tiers of a 2-level             multi-axis tori
+           factorization (mesh axes under
+           ``comm_from_mesh``, or the ``hier``
+           grouping of a flat axis), one concurrent
+           grouped RS→AR→AG channel per axis
 =========  ===========================================  ==============
+
+``bidir``/``torus`` form the *bandwidth tier* ("The Big Send-off",
+arXiv:2504.18658 multipath schedules; GC3's multi-channel programs): the
+selector reaches them only at/above the measured
+``config.bandwidth_crossover_bytes`` — the third tier of auto selection.
 """
 
 from __future__ import annotations
@@ -53,6 +69,10 @@ class AlgorithmSpec:
     name: str
     collectives: Tuple[str, ...] = ("allreduce",)
     latency_optimal: bool = False
+    # Marks the multipath bandwidth tier: the selector prefers these at/
+    # above the measured config.bandwidth_crossover_bytes, and the
+    # autotuner derives that crossover from the sizes they win.
+    bandwidth_optimal: bool = False
     requires_power_of_two: bool = False
     requires_factorable: bool = False
     description: str = ""
@@ -175,4 +195,24 @@ register_algorithm(AlgorithmSpec(
                 "reduce-scatter → inter-group allreduce → intra-group "
                 "all-gather; groups from mesh axis sizes or a divisor "
                 "of N",
+))
+register_algorithm(AlgorithmSpec(
+    name="bidir",
+    collectives=("allreduce",),
+    bandwidth_optimal=True,
+    description="bidirectional dual-ring allreduce: the payload halves "
+                "ride two counter-rotating collective_permute ring "
+                "reduce-scatter + all-gather chains concurrently — "
+                "~2x link utilization on bidirectional links, any N",
+))
+register_algorithm(AlgorithmSpec(
+    name="torus",
+    collectives=("allreduce",),
+    bandwidth_optimal=True,
+    requires_factorable=True,
+    description="multi-axis torus multipath allreduce: payload halves "
+                "stripe across the two tiers of a 2-level factorization "
+                "(mesh axes under comm_from_mesh, or the hier grouping "
+                "of a flat axis) — one concurrent grouped channel per "
+                "axis",
 ))

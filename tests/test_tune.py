@@ -1,28 +1,38 @@
 """mpi4torch_tpu.tune — size/topology-aware algorithms + autotuner
-(ISSUE 3).
+(ISSUE 3), plus the bandwidth tier (ISSUE 4).
 
 Coverage per the acceptance criteria:
 
-* value + gradient parity of every algorithm (``rhd``/``tree``/``hier``)
-  against ``ring``, on power-of-two and non-power-of-two worlds;
+* value + gradient parity of every algorithm
+  (``rhd``/``tree``/``hier``/``bidir``/``torus``) against ``ring``, on
+  power-of-two and non-power-of-two worlds;
 * bitwise parity: Mode A (SPMD schedule) vs Mode B (rendezvous fold of
   the matching association) per algorithm under ``deterministic_mode``,
   and all algorithms vs ring on exactly-representable data;
 * HLO census proving each algorithm emits its distinct schedule in
   forward AND backward (ring: one all_reduce; rhd: 2·log2 N shrinking
   collective_permutes; tree: 2·log2 N full-width permutes; hier: one
-  reduce_scatter + all_reduce + all_gather triple);
-* selector determinism, the degrade/raise rule (explicit ``rhd`` on a
-  non-power-of-two world raises; a scope default silently degrades to
-  ring), and codec restrictions (q8 is ring-only);
+  reduce_scatter + all_reduce + all_gather triple; bidir: two
+  concurrent counter-rotating collective_permute chains over
+  half-payloads with no dependency between them; torus: one grouped
+  channel per (virtual or real) mesh axis), and the phase-pipelined
+  deterministic ring fold dropping the trailing broadcast hops;
+* a registry-sync guard: every registered ``AlgorithmSpec`` name must
+  appear in the parity/grads and census matrices here, so a future
+  algorithm registered without tests fails CI;
+* selector determinism, three-tier auto selection (latency below the
+  crossover, ring in the middle, multipath at/above the bandwidth
+  crossover), the degrade/raise rule, and codec restrictions (q8 is
+  ring-only);
 * autotuner cache round-trip: persisted winners reload in a fresh
   table, corrupt/stale/wrong-version cache files fall back to defaults
-  without crashing;
-* ``hier`` on a 2D mesh: single-axis grouped form and the two-axis
-  ``comm_from_mesh(mesh, (outer, inner))`` communicator;
+  without crashing; concurrent saves union rather than lose entries;
+  the ``python -m mpi4torch_tpu.tune`` inspection CLI;
+* ``hier``/``torus`` on a 2D mesh: single-axis grouped forms and the
+  two-axis ``comm_from_mesh(mesh, (outer, inner))`` communicator;
 * fused per-bucket picks: small tail buckets take the latency
   algorithm below the measured crossover while body buckets keep the
-  ring pair.
+  ring pair — or the multipath algorithm past the bandwidth crossover.
 """
 
 import json
@@ -41,11 +51,34 @@ from mpi4torch_tpu._compat import shard_map
 
 NR = 8
 CENSUS_NR = 4
-ALGOS = ("ring", "rhd", "tree", "hier")
+ALGOS = ("ring", "rhd", "tree", "hier", "bidir", "torus")
+# Algorithms with a dedicated forward+backward HLO census below.  The
+# registry-sync guard asserts this set — and ALGOS — equals the
+# registry, so registering an algorithm without census coverage fails
+# here rather than shipping untested.
+CENSUS_COVERED = frozenset(ALGOS)
 COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
                "collective_permute")
 
 comm = mpi.COMM_WORLD
+
+
+def test_registry_sync_guard():
+    """Every registered AlgorithmSpec name must be exercised by the
+    parity/grads matrix (ALGOS — parametrizes TestAlgorithmParity and
+    TestBitwiseDeterministicParity) AND the HLO census matrix
+    (CENSUS_COVERED).  A future algorithm registered in tune/registry.py
+    without extending these matrices fails CI right here."""
+    registered = set(tune.available_algorithms())
+    assert registered == set(ALGOS), (
+        f"registered algorithms {sorted(registered)} out of sync with "
+        f"the parity/grads test matrix {sorted(set(ALGOS))} — extend "
+        "ALGOS (and the tests it parametrizes)")
+    assert registered == set(CENSUS_COVERED), (
+        f"registered algorithms {sorted(registered)} out of sync with "
+        f"the HLO census matrix {sorted(CENSUS_COVERED)} — add a "
+        "forward+backward census test and list the name in "
+        "CENSUS_COVERED")
 
 
 @pytest.fixture(autouse=True)
@@ -59,6 +92,8 @@ def _isolated_tune_state(tmp_path, monkeypatch):
     yield
     tune.clear()
     mpi.config.set_latency_crossover_bytes(None)
+    mpi.config.set_bandwidth_crossover_bytes(None)
+    mpi.config.set_phase_pipelined_ring(True)
     mpi.config.set_hier_group_size(None)
     mpi.config.set_default_algorithm(None)
 
@@ -109,7 +144,8 @@ class TestAlgorithmParity:
                                    rtol=1e-5, atol=1e-5)
 
     @pytest.mark.parametrize("nr,algo", [(3, "tree"), (6, "tree"),
-                                         (6, "hier")])
+                                         (6, "hier"), (3, "bidir"),
+                                         (6, "bidir"), (6, "torus")])
     def test_non_power_of_two_worlds(self, nr, algo):
         rng = np.random.default_rng(5)
         data = jnp.asarray(rng.standard_normal((nr, 19)).astype(np.float32))
@@ -135,7 +171,7 @@ class TestAlgorithmParity:
             return comm.Allreduce(t, mpi.MPI_MAX, algorithm=a)
 
         want = np.asarray(mpi.run_spmd(lambda x: body(x, "ring"))(data))
-        for algo in ("rhd", "tree"):
+        for algo in ("rhd", "tree", "bidir", "torus"):
             got = np.asarray(mpi.run_spmd(lambda x, a=algo: body(x, a))(data))
             np.testing.assert_array_equal(got, want, err_msg=algo)
 
@@ -204,7 +240,7 @@ class TestBitwiseDeterministicParity:
         with mpi.config.deterministic_mode(True):
             want = np.asarray(
                 mpi.run_spmd(lambda x: det_body(x, "ring"))(data))
-            for algo in ("rhd", "tree", "hier"):
+            for algo in ("rhd", "tree", "hier", "bidir", "torus"):
                 got = np.asarray(
                     mpi.run_spmd(lambda x, a=algo: det_body(x, a))(data))
                 np.testing.assert_array_equal(got, want, err_msg=algo)
@@ -261,6 +297,69 @@ class TestAlgorithmCensus:
         got, _ = self._fwd("hier")
         assert got == only(reduce_scatter=1, all_reduce=1, all_gather=1)
 
+    # The two counter-rotating ring directions of the bidir dual-ring,
+    # as collective_permute source_target_pairs attribute payloads.
+    _FWD_RING = "[[0, 1], [1, 2], [2, 3], [3, 0]]"
+    _REV_RING = "[[0, 3], [1, 0], [2, 1], [3, 2]]"
+
+    def _permute_pair_tables(self, txt):
+        return re.findall(
+            r"collective_permute.*?source_target_pairs = dense<(\[\[.*?\]\])>",
+            txt)
+
+    def test_bidir_is_two_counter_rotating_half_payload_chains(self):
+        # The ISSUE 4 multipath criterion: two CONCURRENT
+        # counter-rotating collective_permute chains over half-payloads
+        # with no serialization barrier between them — each chain is an
+        # explicit ring reduce-scatter + all-gather, 2(N-1) hops.
+        got, txt = self._fwd("bidir")
+        assert got == only(collective_permute=4 * (CENSUS_NR - 1)), got
+        tables = self._permute_pair_tables(txt)
+        # exactly half the permutes ride each direction
+        assert tables.count(self._FWD_RING) == 2 * (CENSUS_NR - 1), tables
+        assert tables.count(self._REV_RING) == 2 * (CENSUS_NR - 1), tables
+        # every permute moves a SEGMENT of a half-payload (16 elems ->
+        # 8-elem halves -> 2-elem ring segments), never the full tensor
+        widths = re.findall(
+            r"collective_permute.*?:\s*\(tensor<(\d+)x", txt)
+        assert widths and all(
+            int(w) == 16 // 2 // CENSUS_NR for w in widths), widths
+        # no serialization barrier between the chains: neither chain's
+        # permutes consume the other's values, so no optimization_barrier
+        # op separates them in the lowered module
+        assert "optimization_barrier" not in txt
+
+    def test_bidir_backward_rides_swapped_channels(self):
+        # The adjoint of a ring segment is a ring segment in the reverse
+        # direction: backward = the same dual-ring machinery, so fwd+bwd
+        # shows exactly twice the chains, still evenly split between the
+        # two rotations (the swap flips which half rides which).
+        got, txt = self._fwd_bwd("bidir")
+        assert got == only(collective_permute=8 * (CENSUS_NR - 1)), got
+        tables = self._permute_pair_tables(txt)
+        assert tables.count(self._FWD_RING) == 4 * (CENSUS_NR - 1), tables
+        assert tables.count(self._REV_RING) == 4 * (CENSUS_NR - 1), tables
+
+    def test_torus_is_one_grouped_channel_per_axis(self):
+        # Flat-axis torus: the hier factorization viewed as a virtual 2D
+        # torus with the payload STRIPED across the two tiers — one
+        # grouped reduce-scatter/all-reduce/all-gather channel per
+        # (virtual) axis, concurrent because the halves share no values.
+        got, txt = self._fwd("torus")
+        assert got == only(reduce_scatter=2, all_reduce=2,
+                           all_gather=2), got
+        # the two channels' first-stage reduce_scatters ride DIFFERENT
+        # axes of the factorization: consecutive inner groups for one,
+        # strided outer groups for the other (4 ranks -> 2x2)
+        groups = set(re.findall(
+            r"reduce_scatter.*?replica_groups = dense<(\[\[.*?\]\])>",
+            txt))
+        assert groups == {"[[0, 1], [2, 3]]", "[[0, 2], [1, 3]]"}, groups
+
+    def test_torus_backward_census_doubles(self):
+        got, _ = self._fwd_bwd("torus")
+        assert got == only(reduce_scatter=4, all_reduce=4, all_gather=4)
+
     def test_backward_census_matches_forward_per_algorithm(self):
         logn = int(math.log2(CENSUS_NR))
         got, _ = self._fwd_bwd("ring")
@@ -271,6 +370,79 @@ class TestAlgorithmCensus:
         assert got == only(collective_permute=4 * logn), got
         got, _ = self._fwd_bwd("hier")
         assert got == only(reduce_scatter=2, all_reduce=2, all_gather=2)
+
+    def test_phase_pipelined_ring_fold_drops_broadcast_steps(self):
+        # ISSUE 4: the deterministic chunked ring fold's all-gather head
+        # overlaps the reduce-scatter tail — completed chunks relay
+        # around the ring inside the SAME fused scan, so the trailing
+        # full-payload tree-broadcast hops (ceil(log2 N) sequential
+        # whole-tensor permutes AFTER the fold loop in the baseline)
+        # disappear: fewer sequential permute steps than the two-phase
+        # baseline, and every permute is chunk-sized and lives in the
+        # loop.
+        saved = (mpi.config.ordered_fold_gather_max_bytes(),
+                 mpi.config.ordered_ring_chunk_bytes())
+        mpi.config.set_ordered_fold_gather_max_bytes(0)  # force ring fold
+        mpi.config.set_ordered_ring_chunk_bytes(64)      # 16 f64 -> 2 chunks
+        try:
+            with mpi.config.deterministic_mode(True):
+                mpi.config.set_phase_pipelined_ring(False)
+                base, btxt = census(
+                    lambda c, v: c.Allreduce(v, mpi.MPI_SUM), self.X)
+                mpi.config.set_phase_pipelined_ring(True)
+                pipe, ptxt = census(
+                    lambda c, v: c.Allreduce(v, mpi.MPI_SUM), self.X)
+        finally:
+            mpi.config.set_ordered_fold_gather_max_bytes(saved[0])
+            mpi.config.set_ordered_ring_chunk_bytes(saved[1])
+            mpi.config.set_phase_pipelined_ring(True)
+        # baseline: 1 in-loop fold permute + ceil(log2 N) tree hops
+        logn = int(math.ceil(math.log2(CENSUS_NR)))
+        assert base == only(collective_permute=1 + logn), base
+        # pipelined: fold + relay lanes, both inside the one scan — no
+        # trailing broadcast permutes at all
+        assert pipe == only(collective_permute=2), pipe
+        assert pipe["collective_permute"] < base["collective_permute"]
+        # the baseline's extra hops are FULL-payload (16 elems); the
+        # pipelined program never permutes more than one chunk (8 elems)
+        def widths(txt):
+            return {int(w) for w in re.findall(
+                r"collective_permute.*?:\s*\(tensor<(\d+)x", txt)}
+        assert 16 in widths(btxt), widths(btxt)
+        assert max(widths(ptxt)) <= 8, widths(ptxt)
+
+    def test_phase_pipelined_ring_fold_bits_identical(self):
+        # Pipelining must not touch the fold association: both forms are
+        # bit-identical to each other and to the eager oracle.
+        rng = np.random.default_rng(29)
+        data = jnp.asarray(
+            rng.standard_normal((NR, 3000)).astype(np.float32))
+
+        def det_body(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            return comm.Allreduce(t, mpi.MPI_SUM)
+
+        saved = (mpi.config.ordered_fold_gather_max_bytes(),
+                 mpi.config.ordered_ring_chunk_bytes())
+        mpi.config.set_ordered_fold_gather_max_bytes(0)
+        mpi.config.set_ordered_ring_chunk_bytes(1024)
+        try:
+            with mpi.config.deterministic_mode(True):
+                mpi.config.set_phase_pipelined_ring(False)
+                base = np.asarray(mpi.run_spmd(det_body)(data))
+                mpi.config.set_phase_pipelined_ring(True)
+                pipe = np.asarray(mpi.run_spmd(det_body)(data))
+        finally:
+            mpi.config.set_ordered_fold_gather_max_bytes(saved[0])
+            mpi.config.set_ordered_ring_chunk_bytes(saved[1])
+            mpi.config.set_phase_pipelined_ring(True)
+        np.testing.assert_array_equal(base, pipe)
+        oracle = mpi.run_ranks(
+            lambda: np.asarray(comm.Allreduce(
+                data[comm.rank], mpi.MPI_SUM)), NR)
+        for r in range(NR):
+            np.testing.assert_array_equal(pipe[r], oracle[r])
 
     def test_reduce_tree_is_log_permutes(self):
         got, _ = census(
@@ -381,10 +553,90 @@ class TestSelector:
                             jnp.ones((16,)))
         assert got == only(collective_permute=logn), got
 
+    def test_bandwidth_crossover_drives_multipath_pick(self):
+        # The third tier: latency algorithm below the latency crossover,
+        # ring in the middle, the multipath dual-ring at/above the
+        # bandwidth crossover.
+        mpi.config.set_latency_crossover_bytes(4096)
+        mpi.config.set_bandwidth_crossover_bytes(1 << 20)
+        assert tune.select_auto(nbytes=512, dtype=jnp.float32,
+                                nranks=NR) == "rhd"
+        assert tune.select_auto(nbytes=64 * 1024, dtype=jnp.float32,
+                                nranks=NR) == "ring"
+        assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
+                                nranks=NR) == "bidir"
+        # any-world: bidir needs no factorization or power of two
+        assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
+                                nranks=5) == "bidir"
+
+    def test_bandwidth_tier_respects_determinism_and_codecs(self):
+        from mpi4torch_tpu.compress import get_codec
+        mpi.config.set_bandwidth_crossover_bytes(1 << 20)
+        # deterministic mode pins the bit-exact ring fold
+        assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
+                                nranks=NR, deterministic=True) == "ring"
+        # a ring-only codec keeps large compressed payloads on the ring
+        assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
+                                nranks=NR, codec=get_codec("q8")) == "ring"
+
+    def test_cached_multipath_winner_wins(self):
+        tune.record("allreduce", jnp.float32, 8 << 20, NR, "torus")
+        assert tune.select_auto(nbytes=8 << 20, dtype=jnp.float32,
+                                nranks=NR) == "torus"
+        # a cached torus winner cannot serve a prime world: auto falls
+        # back (never returns an algorithm the backend would reject)
+        tune.record("allreduce", jnp.float32, 8 << 20, 5, "torus")
+        assert tune.select_auto(nbytes=8 << 20, dtype=jnp.float32,
+                                nranks=5) == "ring"
+
     def test_explicit_hier_on_prime_world_raises(self):
         with pytest.raises(mpi.CommError, match="factorization"):
             mpi.run_spmd(lambda: comm.Allreduce(
                 jnp.ones(4), mpi.MPI_SUM, algorithm="hier"), nranks=5)()
+
+    def test_explicit_torus_on_prime_world_raises_scope_degrades(self):
+        with pytest.raises(mpi.CommError, match="factorization"):
+            mpi.run_spmd(lambda: comm.Allreduce(
+                jnp.ones(4), mpi.MPI_SUM, algorithm="torus"), nranks=5)()
+        # same rule on the eager backend
+        with pytest.raises(mpi.CommError, match="factorization"):
+            mpi.run_ranks(lambda: comm.Allreduce(
+                jnp.ones(4), mpi.MPI_SUM, algorithm="torus"), 5)
+        with mpi.config.algorithm_scope("torus"):
+            out = np.asarray(mpi.run_spmd(
+                lambda: comm.Allreduce(jnp.ones(4), mpi.MPI_SUM),
+                nranks=5)())
+            np.testing.assert_allclose(out, 5.0)
+
+    def test_explicit_bidir_works_on_any_world(self):
+        for nr in (2, 5):
+            out = np.asarray(mpi.run_spmd(
+                lambda: comm.Allreduce(jnp.ones(7), mpi.MPI_SUM,
+                                       algorithm="bidir"),
+                nranks=nr)())
+            np.testing.assert_allclose(out, float(nr))
+
+    def test_bidir_scan_form_bitwise_matches_unrolled(self, monkeypatch):
+        # Past _CHAIN_UNROLL_MAX ranks each chain phase rolls into a
+        # lax.scan (O(1) program size on big pods); the wire schedule —
+        # and therefore the bits — must be identical to the unrolled
+        # census form.  Force the scan form on the 8-rank world.
+        from mpi4torch_tpu.ops import spmd as _spmd
+        rng = np.random.default_rng(31)
+        data = jnp.asarray(rng.standard_normal((NR, 37)).astype(np.float32))
+
+        def body(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            y, g = jax.value_and_grad(lambda v: jnp.vdot(
+                comm.Allreduce(v, mpi.MPI_SUM, algorithm="bidir"), v))(t)
+            return y, g
+
+        uy, ug = mpi.run_spmd(body)(data)
+        monkeypatch.setattr(_spmd, "_CHAIN_UNROLL_MAX", 2)
+        sy, sg = mpi.run_spmd(body)(data)
+        np.testing.assert_array_equal(np.asarray(uy), np.asarray(sy))
+        np.testing.assert_array_equal(np.asarray(ug), np.asarray(sg))
 
     def test_unknown_algorithm_raises(self):
         with pytest.raises(ValueError, match="unknown collective"):
@@ -465,6 +717,89 @@ class TestCacheRoundTrip:
         g0 = tune.generation()
         tune.record("allreduce", "float32", 512, 8, "tree")
         assert tune.generation() > g0
+
+    def test_concurrent_saves_union_instead_of_losing_work(self):
+        # Two processes tuning simultaneously: each write goes through a
+        # UNIQUE tempfile + os.replace (readers never see a torn file)
+        # and merges entries the other process persisted meanwhile —
+        # last-writer-wins only per key, never whole-file.
+        import os
+        tune.record("allreduce", "float32", 512, 8, "rhd")
+        # simulate the OTHER process persisting its own winner between
+        # our record() calls: inject a foreign key directly on disk
+        with open(tune.cache_path()) as f:
+            data = json.load(f)
+        foreign = tune.make_key("allreduce", "float32", 1 << 20, 16,
+                                platform="cpu")
+        data["entries"][foreign] = {"algorithm": "bidir"}
+        with open(tune.cache_path(), "w") as f:
+            json.dump(data, f)
+        tune.record("allreduce", "float32", 2048, 8, "tree")
+        with open(tune.cache_path()) as f:
+            final = json.load(f)
+        assert final["entries"][foreign]["algorithm"] == "bidir"
+        algos = {e["algorithm"] for e in final["entries"].values()}
+        assert algos == {"rhd", "tree", "bidir"}
+        # no staging litter left behind in the cache directory
+        cache_dir = os.path.dirname(tune.cache_path())
+        assert not [p for p in os.listdir(cache_dir)
+                    if p.endswith(".tmp")]
+
+    def test_unwritable_cache_dir_degrades_in_process(self, monkeypatch,
+                                                      tmp_path):
+        # The save is best-effort: a cache path whose directory cannot
+        # be created degrades to in-process-only tuning, never an error.
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a directory")
+        monkeypatch.setenv("MPI4TORCH_TPU_TUNE_CACHE",
+                           str(blocker / "tune_cache.json"))
+        tune.clear()
+        tune.record("allreduce", "float32", 512, 8, "tree")
+        assert tune.lookup_algorithm("allreduce", "float32", 512,
+                                     8) == "tree"
+
+
+class TestCacheCli:
+    """`python -m mpi4torch_tpu.tune --show/--clear` (ISSUE 4
+    satellite): the winners table without reading raw JSON."""
+
+    def _run(self, *argv):
+        from mpi4torch_tpu.tune.__main__ import _main
+        return _main(list(argv))
+
+    def test_show_prints_winners_table(self, capsys):
+        tune.record("allreduce", "float32", 512, 8, "rhd",
+                    platform="cpu",
+                    measurements={"ring": 1e-3, "rhd": 5e-4})
+        tune.record("allreduce", "float32", 4 << 20, 8, "bidir",
+                    platform="cpu")
+        assert self._run("--show") == 0
+        out = capsys.readouterr().out
+        # one row per key: collective, dtype, size bucket, nranks,
+        # platform -> algorithm
+        assert re.search(r"allreduce\s+float32\s+512\s+8\s+cpu\s+rhd", out)
+        assert re.search(r"allreduce\s+float32\s+4194304\s+8\s+cpu\s+bidir",
+                         out)
+        assert "2 cached winner(s)" in out
+
+    def test_show_empty_and_missing_cache(self, capsys):
+        assert self._run() == 0
+        assert "no cache" in capsys.readouterr().out
+
+    def test_clear_removes_file(self, capsys):
+        tune.record("allreduce", "float32", 512, 8, "tree")
+        assert self._run("--clear") == 0
+        tune.clear()
+        assert tune.lookup("allreduce", "float32", 512, 8) is None
+        assert self._run("--clear") == 0   # idempotent
+        assert "no cache file" in capsys.readouterr().out
+
+    def test_json_dump(self, capsys):
+        tune.record("allreduce", "float32", 512, 8, "tree")
+        assert self._run("--json") == 0
+        data = json.loads(capsys.readouterr().out)
+        assert any(e["algorithm"] == "tree"
+                   for e in data["entries"].values())
 
 
 class TestAutotunerMeasurement:
@@ -562,6 +897,84 @@ class TestHier2DMesh:
         for r in range(8):
             np.testing.assert_array_equal(a_out[0], b_out[r])
 
+    def test_two_axis_torus_census_one_channel_per_axis(self):
+        # The ISSUE 4 acceptance criterion: torus on a 2D mesh lowers to
+        # one ring channel per axis — the halves' first-stage grouped
+        # reduce_scatters ride the inner ("l") and outer ("g") mesh axes
+        # respectively (distinct replica_groups), with no dependency
+        # between the halves.
+        mesh = self._mesh2d()
+        hc = mpi.comm_from_mesh(mesh, ("g", "l"))
+        got, txt = census(
+            lambda cc, x: cc.Allreduce(x, mpi.MPI_SUM, algorithm="torus"),
+            jnp.arange(12.0), mesh_axes=(mesh, hc))
+        assert got == only(reduce_scatter=2, all_reduce=2, all_gather=2)
+        groups = set(re.findall(
+            r"reduce_scatter.*?replica_groups = dense<(\[\[.*?\]\])>",
+            txt))
+        assert groups == {"[[0, 1, 2, 3], [4, 5, 6, 7]]",
+                          "[[0, 4], [1, 5], [2, 6], [3, 7]]"}, groups
+
+    def test_two_axis_torus_values_and_grads(self):
+        mesh = self._mesh2d()
+        hc = mpi.comm_from_mesh(mesh, ("g", "l"))
+        x = jnp.arange(13.0, dtype=jnp.float32)
+        f = jax.jit(shard_map(
+            lambda v: hc.Allreduce(v, mpi.MPI_SUM, algorithm="torus"),
+            mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+        np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) * 8)
+        g = jax.jit(shard_map(
+            lambda v: jax.grad(lambda y: jnp.vdot(
+                hc.Allreduce(y, mpi.MPI_SUM, algorithm="torus"), y))(v),
+            mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(x) * 16)
+
+    def test_two_axis_torus_deterministic_matches_eager_bitwise(self):
+        # Mode A (2-axis torus schedule, deterministic grouped-halves
+        # fold) vs Mode B (constants.reduce_torus with inner = the
+        # inner-axis extent): the ISSUE 4 A/B contract on a 2D-mesh
+        # world.
+        mesh = self._mesh2d()
+        hc = mpi.comm_from_mesh(mesh, ("g", "l"))
+        rng = np.random.default_rng(23)
+        data = jnp.asarray(rng.standard_normal((8, 21)).astype(np.float32))
+
+        def det_body(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, hc.rank, 0, keepdims=False)
+            return hc.Allreduce(t, mpi.MPI_SUM, algorithm="torus")
+
+        with mpi.config.deterministic_mode(True):
+            f = jax.jit(shard_map(det_body, mesh=mesh, in_specs=P(),
+                                  out_specs=P(("g", "l")),
+                                  check_vma=False))
+            a_out = np.asarray(f(data)).reshape(8, -1)
+        mpi.config.set_hier_group_size(4)
+        try:
+            b_out = mpi.run_ranks(
+                lambda: np.asarray(comm.Allreduce(
+                    data[comm.rank], mpi.MPI_SUM, algorithm="torus")), 8)
+        finally:
+            mpi.config.set_hier_group_size(None)
+        for r in range(8):
+            np.testing.assert_array_equal(a_out[0], b_out[r])
+
+    def test_two_axis_auto_picks_torus_past_bandwidth_crossover(self):
+        # The 2-axis backend grows the bandwidth tier too: auto = the
+        # staged hier schedule below the measured crossover, the
+        # multipath torus striping at/above it.
+        mesh = self._mesh2d()
+        hc = mpi.comm_from_mesh(mesh, ("g", "l"))
+        mpi.config.set_bandwidth_crossover_bytes(1 << 10)
+        big = jnp.ones((512,))    # 4 KiB f64 >= crossover
+        got, _ = census(lambda cc, x: cc.Allreduce(x, mpi.MPI_SUM),
+                        big, mesh_axes=(mesh, hc))
+        assert got == only(reduce_scatter=2, all_reduce=2, all_gather=2)
+        small = jnp.ones((16,))   # 128 B < crossover: staged hier
+        got, _ = census(lambda cc, x: cc.Allreduce(x, mpi.MPI_SUM),
+                        small, mesh_axes=(mesh, hc))
+        assert got == only(reduce_scatter=1, all_reduce=1, all_gather=1)
+
     def test_two_axis_comm_rejects_other_ops_and_algorithms(self):
         mesh = self._mesh2d()
         hc = mpi.comm_from_mesh(mesh, ("g", "l"))
@@ -574,6 +987,18 @@ class TestHier2DMesh:
                 lambda x: hc.Allreduce(x, mpi.MPI_SUM, algorithm="rhd"),
                 mesh=mesh, in_specs=P(), out_specs=P(),
                 check_vma=False)).lower(jnp.ones(4))
+        # bidir needs a single ring axis too: explicit raises, scope
+        # yields to the native schedule
+        with pytest.raises(mpi.CommError, match="single-axis"):
+            jax.jit(shard_map(
+                lambda x: hc.Allreduce(x, mpi.MPI_SUM,
+                                       algorithm="bidir"),
+                mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False)).lower(jnp.ones(4))
+        with mpi.config.algorithm_scope("bidir"):
+            got, _ = census(lambda cc, x: cc.Allreduce(x, mpi.MPI_SUM),
+                            jnp.ones(16), mesh_axes=(mesh, hc))
+        assert got == only(reduce_scatter=1, all_reduce=1, all_gather=1)
 
     def test_invalid_config_group_raises(self):
         mpi.config.set_hier_group_size(3)   # does not divide 8
@@ -706,6 +1131,20 @@ class TestFusePerBucket:
         # bucket (40 B < crossover): the rhd butterfly
         assert got == only(reduce_scatter=1, all_gather=1,
                            collective_permute=2 * logn), got
+
+    def test_body_bucket_takes_bidir_past_bandwidth_crossover(self):
+        # Three-tier fused picks (ISSUE 4): the body bucket (12000 B,
+        # past the bandwidth crossover) rides the multipath dual-ring —
+        # two counter-rotating chains — while the 40 B tail bucket keeps
+        # the latency algorithm; no ring pair remains.
+        logn = int(math.log2(CENSUS_NR))
+        mpi.config.set_latency_crossover_bytes(1024)
+        mpi.config.set_bandwidth_crossover_bytes(8192)
+        got, _ = census(
+            lambda c, t: c.Allreduce_tree(t, mpi.MPI_SUM,
+                                          bucket_bytes=8192), self.TREE)
+        assert got == only(
+            collective_permute=4 * (CENSUS_NR - 1) + 2 * logn), got
 
     def test_without_crossover_all_buckets_keep_ring_pair(self):
         got, _ = census(
