@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -37,6 +37,19 @@ tune-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.tune.autotuner --smoke
+
+# CPU smoke run of the split-phase overlap machinery
+# (mpi4torch_tpu.overlap): the windowed scheduler on a DP gradient
+# tree AND a full ZeRO step with the double-buffered parameter
+# prefetch, each checked BITWISE against its blocking form on the
+# 8-virtual-device mesh; exits non-zero on any divergence.  Wall-clock
+# numbers are informational here (the CPU collective runtime is
+# synchronous); bench.py's overlap_zero stanza records the real
+# exposed-comm fractions on hardware.
+overlap-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.overlap --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -403,6 +403,237 @@ def _bench_allreduce_fused(on_tpu: bool):
     return out
 
 
+def _overlap_zero_setup(on_tpu: bool):
+    """Model, optimizer and stand-in gradient tree shared by the
+    overlap_zero wall-clock measurement and its schedule census
+    (including the forced-multi-device censusing subprocess a 1-device
+    run spawns — both sides must build the SAME step programs)."""
+    import jax
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu.models import transformer as T
+
+    if on_tpu:
+        cfg = T.TransformerConfig(vocab=8192, d_model=512, n_heads=8,
+                                  n_layers=8, d_ff=2048, max_seq=256)
+        iters = 10
+        bucket_bytes = mpi.config.default_bucket_bytes()
+    else:
+        cfg = T.TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                  n_layers=2, d_ff=128, max_seq=32)
+        iters = 5
+        # Small buckets so the smoke tree still splits into a real
+        # multi-bucket window (the default 4 MiB would make it one
+        # bucket — nothing for the scheduler to keep in flight).
+        bucket_bytes = 1 << 15
+    params = T.init_transformer(jax.random.PRNGKey(0), cfg)
+    # Stand-in UN-reduced local gradient tree (the fused bench's trick):
+    # the wire and optimizer cost are shape-determined, not
+    # value-determined.
+    grads = jax.tree.map(lambda p: p * 1e-3, params)
+
+    class _Sgd:
+        def init(self, p):
+            return None
+
+        def update(self, g, s, p):
+            return jax.tree.map(lambda x: -0.1 * x, g), None
+
+    return params, grads, _Sgd(), bucket_bytes, iters
+
+
+def _overlap_zero_step_fn(comm, opt, params, bucket_bytes, overlap):
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu.parallel import zero as Z
+
+    def f(g):
+        with mpi.config.fusion_scope(bucket_bytes):
+            st = Z.zero_init(comm, opt, params)
+            new_p, _ = Z.zero_step(comm, opt, params, g, st,
+                                   overlap=overlap)
+        return new_p
+    return f
+
+
+def _overlap_zero_census(on_tpu: bool = False):
+    """Schedule census of the ZeRO step's two forms (mpi4torch_tpu.
+    overlap.scheduled_exposure): the fraction of bucket collectives the
+    lowered program leaves with NOTHING else in flight to hide them.
+    Deterministic on every platform — blocking steps census to 1.0 by
+    construction, the windowed split-phase step strictly lower."""
+    import jax
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu.overlap import scheduled_exposure
+
+    params, grads, opt, bucket_bytes, _ = _overlap_zero_setup(on_tpu)
+    comm = mpi.COMM_WORLD
+    out = {"n_devices": len(jax.devices())}
+    for name, ov in (("blocking", False), ("overlap", True)):
+        f = _overlap_zero_step_fn(comm, opt, params, bucket_bytes, ov)
+        out[name] = scheduled_exposure(
+            jax.jit(mpi.run_spmd(f)).lower(grads))
+    return out
+
+
+def _overlap_zero_census_subprocess():
+    """Run :func:`_overlap_zero_census` on a forced 8-virtual-device CPU
+    mesh in a subprocess — the multi-device smoke sweep for a bench run
+    whose own world has a single device (collectives lower away there,
+    so the in-process census would have nothing to count)."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    code = ("import json, bench; "
+            "print(json.dumps(bench._overlap_zero_census(False)))")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"census subprocess failed (rc {proc.returncode}): "
+            f"{proc.stderr.strip()[-300:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _bench_overlap_zero(on_tpu: bool):
+    """ZeRO step on a models/ transformer grad tree, blocking vs
+    split-phase overlap (mpi4torch_tpu.overlap, ISSUE 5): persists the
+    *exposed-comm fraction* for both schedules, plus the overlap
+    speedup.  Two estimators of the same quantity:
+
+    * ``exposed_comm_fraction_measured`` — wall-clock,
+      ``(t_full - t_compute_only) / t_full``: the share of the step the
+      wire is NOT hidden behind compute.  The real number on multi-chip
+      hardware with an async collective runtime; on the CPU smoke mesh
+      the in-process rendezvous is synchronous and the comparison is
+      scheduler noise (measured here and kept, but informational).
+    * ``exposed_comm_fraction_scheduled`` — the deterministic schedule
+      census (:func:`mpi4torch_tpu.overlap.scheduled_exposure`): the
+      fraction of bucket collectives whose start→wait window the
+      lowered program leaves EMPTY (nothing in flight to hide them).
+      Blocking steps census to 1.0 by construction; the windowed
+      split-phase step strictly lower.
+
+    The headline per-variant ``exposed_comm_fraction`` (and the
+    ``overlap_fraction_lower`` verdict) is the measured one on TPU and
+    the scheduled one on the CPU smoke sweep — best available estimator
+    per platform.  A 1-device bench world runs the census on a forced
+    8-virtual-device subprocess mesh so the multi-device verdict is
+    recorded either way."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu.fuse import bucket_layout
+    from mpi4torch_tpu.parallel import zero as Z
+
+    n = len(jax.devices())
+    params, grads, opt, bucket_bytes, iters = _overlap_zero_setup(on_tpu)
+    leaves = jax.tree.leaves(grads)
+    total_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+
+    comm = mpi.COMM_WORLD
+
+    def full_step(overlap):
+        return _overlap_zero_step_fn(comm, opt, params, bucket_bytes,
+                                     overlap)
+
+    def compute_only(g):
+        # The step's compute with the wire legs cut: shard locally
+        # (pure slicing), update — no reduce-scatter, no all-gather.
+        st = Z.zero_init(comm, opt, params)
+        g_shards = Z.zero3_shard_params(comm, g)
+        p_shards = Z.zero3_shard_params(comm, params)
+        updates, _ = opt.update(g_shards, st, p_shards)
+        return jax.tree.map(jnp.add, p_shards, updates)
+
+    def timed(fn):
+        step = mpi.run_spmd(fn)
+        return _timeit(step, grads, iters=iters)
+
+    layout = bucket_layout(grads, bucket_bytes)
+    out = {
+        "n_devices": n,
+        "n_leaves": len(leaves),
+        "n_buckets": layout.num_buckets,
+        "grad_tree_mib": round(total_bytes / (1 << 20), 3),
+        "bucket_bytes": bucket_bytes,
+    }
+    t_compute = _guarded("overlap_zero.compute_only", timed, compute_only)
+    variants = {}
+    for name, ov in (("blocking", False), ("overlap", True)):
+        def _one(ov=ov):
+            t_full = timed(full_step(ov))
+            exposed = max(0.0, t_full - t_compute) / t_full \
+                if isinstance(t_compute, float) and t_full > 0 else None
+            return {"seconds_per_step": t_full,
+                    "exposed_comm_fraction_measured": (
+                        round(exposed, 4) if exposed is not None
+                        else None)}
+        variants[name] = _guarded(f"overlap_zero.{name}", _one)
+
+    # The deterministic half: census the two step programs' schedules.
+    # A 1-device world's collectives lower away, so the census runs on a
+    # forced 8-virtual-device subprocess mesh there (the multi-device
+    # smoke sweep); otherwise in-process on the measuring world.
+    census = _guarded(
+        "overlap_zero.census",
+        _overlap_zero_census if n > 1 else _overlap_zero_census_subprocess,
+        *((on_tpu,) if n > 1 else ()))
+    if "error" not in census:
+        out["census_n_devices"] = census.get("n_devices")
+        for name in ("blocking", "overlap"):
+            cv = census.get(name) or {}
+            if isinstance(variants.get(name), dict):
+                variants[name]["exposed_comm_fraction_scheduled"] = \
+                    cv.get("exposed_fraction")
+                variants[name]["census_buckets"] = cv.get("n_buckets")
+    else:
+        out["census_error"] = census["error"]
+    # Headline fraction: the best available estimator per platform —
+    # wall-clock where the collective runtime is genuinely async (TPU),
+    # the schedule census on the CPU smoke path (the synchronous
+    # in-process rendezvous makes wall-clock deltas scheduler noise).
+    headline_key = ("exposed_comm_fraction_measured" if on_tpu
+                    else "exposed_comm_fraction_scheduled")
+    for name in ("blocking", "overlap"):
+        if isinstance(variants.get(name), dict):
+            variants[name]["exposed_comm_fraction"] = \
+                variants[name].get(headline_key)
+    out["compute_only_seconds"] = t_compute
+    out["variants"] = variants
+    blk, ovl = variants.get("blocking", {}), variants.get("overlap", {})
+    ef_b = blk.get("exposed_comm_fraction")
+    ef_o = ovl.get("exposed_comm_fraction")
+    if ef_b is not None and ef_o is not None:
+        out["overlap_fraction_lower"] = bool(ef_o < ef_b)
+        if not on_tpu:
+            out["note"] = (
+                "cpu smoke: exposed-comm fractions are the scheduled "
+                "census (deterministic; blocking = 1.0 by construction)"
+                " — the synchronous in-process collective runtime makes "
+                "the wall-clock _measured fractions scheduler noise; on "
+                "multi-chip hardware the measured fractions are the "
+                "headline")
+    elif "error" not in census:
+        out["overlap_fraction_lower"] = None
+    if "seconds_per_step" in blk and "seconds_per_step" in ovl:
+        out["overlap_speedup"] = round(
+            blk["seconds_per_step"] / ovl["seconds_per_step"], 4)
+        if n == 1:
+            # One device: a 1-rank psum_scatter/all_gather pair is local
+            # data movement — there is no wire to hide, so the wall-clock
+            # numbers are slicing/copy overhead (the scheduled census
+            # above ran on the forced multi-device subprocess mesh and
+            # still carries the real verdict).
+            out["wall_clock_note"] = (
+                "single device: no wire; measured fractions are "
+                "slicing/copy overhead, not communication")
+    return out
+
+
 def _bench_allreduce_algorithms(on_tpu: bool):
     """Per-algorithm allreduce size sweep (mpi4torch_tpu.tune):
     1 KiB → 64 MiB on hardware (three points on the CPU smoke path),
@@ -942,6 +1173,7 @@ def main() -> None:
         arf = _guarded("allreduce_fused", _bench_allreduce_fused, on_tpu)
         ara = _guarded("allreduce_algorithms", _bench_allreduce_algorithms,
                        on_tpu)
+        ovz = _guarded("overlap_zero", _bench_overlap_zero, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -973,6 +1205,7 @@ def main() -> None:
             "allreduce_compressed": arc,
             "allreduce_fused": arf,
             "allreduce_algorithms": ara,
+            "overlap_zero": ovz,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

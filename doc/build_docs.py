@@ -25,8 +25,8 @@ from pathlib import Path
 DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism",
-         "compression", "fusion", "algorithms", "api_reference",
-         "design_tpu", "glossary"]
+         "compression", "fusion", "algorithms", "overlap",
+         "api_reference", "design_tpu", "glossary"]
 
 CSS = """
 body { font-family: -apple-system, "Segoe UI", Roboto, sans-serif;

@@ -63,7 +63,10 @@ from . import config
 from . import compress
 from . import fuse
 from . import tune
-from .config import algorithm_scope, compression_scope, fusion_scope
+from . import overlap
+from .config import (algorithm_scope, compression_scope, fusion_scope,
+                     overlap_scope)
+from .overlap import SpmdWaitHandle
 
 __all__ = [
     # reference __all__ (src/__init__.py:5-25)
@@ -105,9 +108,12 @@ __all__ = [
     "compress",
     "fuse",
     "tune",
+    "overlap",
+    "SpmdWaitHandle",
     "algorithm_scope",
     "compression_scope",
     "fusion_scope",
+    "overlap_scope",
     "CommError",
     "CollectiveMismatchError",
     "DeadlockError",

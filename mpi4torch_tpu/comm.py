@@ -47,6 +47,15 @@ class WaitHandle:
         (reference: src/__init__.py:34-40)."""
         return self._handle[0]
 
+    def _with_raw(self, raw_handle: List) -> "WaitHandle":
+        """A handle of the same kind over a rebuilt raw 3-tensor — the
+        :func:`JoinDummiesHandle` hook.  Subclasses carrying completion
+        state (the split-phase :class:`mpi4torch_tpu.overlap.
+        SpmdWaitHandle`) override this to share that state with the
+        joined copy, so a double Wait through either handle still
+        raises."""
+        return WaitHandle(raw_handle)
+
 
 def JoinDummies(loopthrough, dummies: Sequence):
     """Join dummy dependencies into the AD graph (reference:
@@ -69,7 +78,7 @@ def JoinDummiesHandle(handle: WaitHandle, dummies: Sequence) -> WaitHandle:
     src/__init__.py:69-87): the dummies are joined onto the descriptor slot
     only."""
     raw = handle._handle
-    return WaitHandle([JoinDummies(raw[0], dummies), raw[1], raw[2]])
+    return handle._with_raw([JoinDummies(raw[0], dummies), raw[1], raw[2]])
 
 
 def _spmd_context():
@@ -239,36 +248,13 @@ class MPI_Communicator:
 
     # ----------------------------------------------------------- collectives
 
-    def Allreduce(self, tensor, op: int, compression=None,
-                  algorithm=None):
-        """Element-wise combine across all ranks, result on every rank
-        (reference: src/__init__.py:125-152, csrc/extension.cpp:274-308).
-        Only ``MPI_SUM`` is differentiable; other ops raise in backward.
-
-        ``compression`` selects a wire codec (:mod:`mpi4torch_tpu.compress`:
-        ``"q8"``, ``"q8_ef"``, ``"bf16"``, ``"bf16r"``, a Codec object, or
-        ``False`` to override an active ``compression_scope``).  Compressed
-        Allreduce is MPI_SUM-only and stays AD-transparent: its backward is
-        itself a compressed Allreduce.  The named scope gains the codec
-        suffix (``mpi4torch.Allreduce.q8``) so profiler traces distinguish
-        compressed transfers.
-
-        ``algorithm`` selects the wire schedule
-        (:mod:`mpi4torch_tpu.tune`: ``"ring"``, ``"rhd"``, ``"tree"``,
-        ``"hier"``, the bandwidth tier ``"bidir"``/``"torus"``, or
-        ``False``/``"auto"`` to override an active
-        ``algorithm_scope``); ``None`` defers to the scope/process
-        default, which defers to the autotuner-backed selector (three
-        tiers: latency algorithms below the measured crossover, ring in
-        the middle, multipath at/above the measured bandwidth
-        crossover).  The backward pass uses the matching algorithm —
-        ``bidir``'s backward rides the same dual-ring machinery with
-        the channel directions swapped.  Codecs declare
-        which algorithms they compose with (``q8`` is ring-only): an
-        explicit algorithm + explicit codec that do not compose raise;
-        with only one of them explicit, the scope-provided half
-        degrades (explicit algorithm → exact wire; explicit codec →
-        ring)."""
+    def _allreduce_plan(self, tensor, op: int, compression, algorithm):
+        """Resolve an Allreduce call's codec/algorithm pair against this
+        communicator's backend — the shared plan of :meth:`Allreduce`
+        and the split-phase :meth:`Allreduce_start` (one resolution
+        path, so the scope/explicit degrade-vs-raise rules can never
+        drift between the blocking and split-phase forms).  Returns
+        ``(backend, codec, algorithm_name, algo_explicit)``."""
         if algorithm is False:
             algorithm = "auto"
         algo_explicit = algorithm not in (None, "auto")
@@ -316,6 +302,40 @@ class MPI_Communicator:
                     "backend has no compressed pipeline); use a "
                     "single-axis comm_from_mesh communicator")
             codec = None
+        return backend, codec, algo, algo_explicit
+
+    def Allreduce(self, tensor, op: int, compression=None,
+                  algorithm=None):
+        """Element-wise combine across all ranks, result on every rank
+        (reference: src/__init__.py:125-152, csrc/extension.cpp:274-308).
+        Only ``MPI_SUM`` is differentiable; other ops raise in backward.
+
+        ``compression`` selects a wire codec (:mod:`mpi4torch_tpu.compress`:
+        ``"q8"``, ``"q8_ef"``, ``"bf16"``, ``"bf16r"``, a Codec object, or
+        ``False`` to override an active ``compression_scope``).  Compressed
+        Allreduce is MPI_SUM-only and stays AD-transparent: its backward is
+        itself a compressed Allreduce.  The named scope gains the codec
+        suffix (``mpi4torch.Allreduce.q8``) so profiler traces distinguish
+        compressed transfers.
+
+        ``algorithm`` selects the wire schedule
+        (:mod:`mpi4torch_tpu.tune`: ``"ring"``, ``"rhd"``, ``"tree"``,
+        ``"hier"``, the bandwidth tier ``"bidir"``/``"torus"``, or
+        ``False``/``"auto"`` to override an active
+        ``algorithm_scope``); ``None`` defers to the scope/process
+        default, which defers to the autotuner-backed selector (three
+        tiers: latency algorithms below the measured crossover, ring in
+        the middle, multipath at/above the measured bandwidth
+        crossover).  The backward pass uses the matching algorithm —
+        ``bidir``'s backward rides the same dual-ring machinery with
+        the channel directions swapped.  Codecs declare
+        which algorithms they compose with (``q8`` is ring-only): an
+        explicit algorithm + explicit codec that do not compose raise;
+        with only one of them explicit, the scope-provided half
+        degrades (explicit algorithm → exact wire; explicit codec →
+        ring)."""
+        backend, codec, algo, algo_explicit = self._allreduce_plan(
+            tensor, op, compression, algorithm)
         scope = "mpi4torch.Allreduce" + (f".{codec.name}" if codec else "")
         if codec is None and algo not in (None, "ring"):
             scope += f".{algo}"
@@ -356,6 +376,47 @@ class MPI_Communicator:
                 self, tree, op, compression=compression,
                 bucket_bytes=bucket_bytes, mean=mean, overlap=overlap,
                 algorithm=algorithm)
+
+    # ------------------------------------------- split-phase collectives
+
+    def Allreduce_start(self, tensor, op: int, compression=None,
+                        algorithm=None) -> WaitHandle:
+        """Split-phase Allreduce, phase 1 (:mod:`mpi4torch_tpu.overlap`):
+        issues the collective's communication *here* and returns an
+        AD-transparent :class:`~mpi4torch_tpu.overlap.SpmdWaitHandle`
+        (the eager ``WaitHandle`` API: ``.dummy``,
+        :func:`JoinDummiesHandle` composes); :meth:`Wait` completes it —
+        compute issued in between can hide the transfer.  Computes the
+        SAME fold as the blocking :meth:`Allreduce` (bit-identical under
+        ``deterministic_mode``), only scheduled differently; the
+        backward pass is itself split-phase with the wait chain
+        reversed.  Split-phase transfers are exact: an explicit
+        ``compression=`` raises, a scope/process codec default degrades
+        to the exact wire.  ``algorithm`` follows the :meth:`Allreduce`
+        contract (non-ring schedules run whole in phase 1, the Wait
+        being their completion point)."""
+        from .overlap import allreduce_start
+        with jax.named_scope("mpi4torch.Allreduce_start"):
+            return allreduce_start(self, tensor, op,
+                                   compression=compression,
+                                   algorithm=algorithm)
+
+    def Reduce_scatter_start(self, tensor, op: int,
+                             scatteraxis: int) -> WaitHandle:
+        """Split-phase :meth:`Reduce_scatter` (the ZeRO gradient-bucket
+        form): the native collective is issued here, :meth:`Wait` pins
+        the completion point.  See :meth:`Allreduce_start`."""
+        from .overlap import reduce_scatter_start
+        with jax.named_scope("mpi4torch.Reduce_scatter_start"):
+            return reduce_scatter_start(self, tensor, op, scatteraxis)
+
+    def Allgather_start(self, tensor, gatheraxis: int) -> WaitHandle:
+        """Split-phase :meth:`Allgather` (the ZeRO-3 parameter-prefetch
+        form: start gathering shard k+1 while layer k computes).  See
+        :meth:`Allreduce_start`."""
+        from .overlap import allgather_start
+        with jax.named_scope("mpi4torch.Allgather_start"):
+            return allgather_start(self, tensor, gatheraxis)
 
     @_named_op
     def Bcast_(self, tensor, root: int, algorithm=None):
@@ -506,7 +567,15 @@ class MPI_Communicator:
     @_named_op
     def Wait(self, waithandle: WaitHandle):
         """Complete a nonblocking request (reference: src/__init__.py:231-232,
-        csrc/extension.cpp:1220-1265)."""
+        csrc/extension.cpp:1220-1265).  One completion verb for the p2p
+        trio AND the split-phase collectives (``*_start``), like
+        ``MPI_Wait``: under the SPMD mesh backend both handle kinds
+        resolve through the trace context; on the other backends a
+        split-phase handle carries its own completion state."""
+        state = getattr(waithandle, "_split_state", None)
+        if state is not None:
+            from .overlap import complete_generic
+            return complete_generic(waithandle)
         return self._backend().wait(waithandle._handle)
 
     @_named_op

@@ -148,6 +148,82 @@ def fusion_scope(bucket_bytes):
 
 
 # ---------------------------------------------------------------------------
+# Split-phase overlap (mpi4torch_tpu.overlap)
+# ---------------------------------------------------------------------------
+
+_process_overlap = None
+
+
+def default_overlap():
+    """The overlap policy facade tree collectives and the parallel/
+    helpers use when no explicit ``overlap=`` is passed: the innermost
+    active :func:`overlap_scope` on this thread, else the process-wide
+    :func:`set_default_overlap` value.
+
+    ``None`` (default) keeps each backend's historical behavior (SPMD:
+    barrier-staged bucket interleave; eager: blocking rendezvous);
+    ``True`` enables the split-phase overlap scheduler
+    (:mod:`mpi4torch_tpu.overlap`) with the default prefetch depth of
+    2; an ``int >= 1`` enables it with that many collectives in
+    flight; ``False`` forces fully blocking schedules."""
+    scoped = getattr(_state, "overlap", _UNSET)
+    return _process_overlap if scoped is _UNSET else scoped
+
+
+def _validated_overlap(value):
+    if value is None or value is False:
+        return value
+    if value is True:
+        return True
+    try:
+        depth = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"overlap must be None, a bool, or a prefetch depth >= 1; "
+            f"got {value!r}") from None
+    if depth < 1:
+        raise ValueError(
+            f"overlap prefetch depth must be >= 1, got {depth}")
+    return depth
+
+
+def set_default_overlap(value) -> None:
+    """Set the process-wide overlap policy (``None``/``True``/``False``
+    or an integer prefetch depth — see :func:`default_overlap`)."""
+    global _process_overlap
+    _process_overlap = _validated_overlap(value)
+
+
+@contextmanager
+def overlap_scope(value):
+    """Lexically scoped overlap policy for the split-phase scheduler::
+
+        with mpi.config.overlap_scope(True):      # 2 buckets in flight
+            grads = comm.Allreduce_tree(grads, mpi.MPI_SUM, mean=True)
+
+        with mpi.config.overlap_scope(3):          # deeper prefetch
+            params = mpi.parallel.zero.zero3_params(comm, shards, tmpl)
+
+    Per-thread like :func:`compression_scope`; ``run_spmd`` re-reads the
+    value at call time and makes it part of its jit cache key, so
+    toggling retraces.  A scope default is a *preference*: buckets it
+    cannot legally serve (e.g. a compressed bucket — the codec pipeline
+    is a fused multi-step collective with no split form) degrade to the
+    blocking path; an explicit ``overlap=`` plus an explicit conflicting
+    argument raises instead, exactly like the compression scope's
+    degrade/raise rule."""
+    prev = getattr(_state, "overlap", _UNSET)
+    _state.overlap = _validated_overlap(value)
+    try:
+        yield
+    finally:
+        if prev is _UNSET:
+            del _state.overlap
+        else:
+            _state.overlap = prev
+
+
+# ---------------------------------------------------------------------------
 # Collective-algorithm selection (mpi4torch_tpu.tune)
 # ---------------------------------------------------------------------------
 
@@ -230,11 +306,12 @@ _ordered_ring_chunk_bytes = DEFAULT_ORDERED_RING_CHUNK_BYTES
 _bcast_tree_max_bytes = DEFAULT_BCAST_TREE_MAX_BYTES
 
 
-def _validated_threshold(nbytes, what: str, minimum: int = 0) -> int:
+def _validated_threshold(nbytes, what: str, minimum: int = 0,
+                         unit: str = "byte count") -> int:
     try:
         nbytes = int(nbytes)
     except (TypeError, ValueError):
-        raise ValueError(f"{what} must be an integer byte count, got "
+        raise ValueError(f"{what} must be an integer {unit}, got "
                          f"{nbytes!r}") from None
     if nbytes < minimum:
         raise ValueError(f"{what} must be >= {minimum}, got {nbytes}")
@@ -352,6 +429,34 @@ def set_phase_pipelined_ring(value: bool) -> None:
     _phase_pipelined_ring = bool(value)
 
 
+# Worlds up to this size unroll the explicit directional ring chains of
+# the `bidir` schedule hop-by-hop (distinct permute ops — maximal
+# scheduling freedom and the HLO-census surface); larger worlds roll
+# each phase into a lax.scan so the compiled program does not grow with
+# the rank count (a 256-rank pod would otherwise emit ~1000 permute ops
+# per bidir allreduce).  Promoted from the ops/spmd.py module constant
+# _CHAIN_UNROLL_MAX (ISSUE 5 satellite), matching the ISSUE 3
+# threshold-promotion pattern: validated setter, run_spmd jit-cache
+# fingerprint coverage, overridable from measurement.
+DEFAULT_CHAIN_UNROLL_MAX = 32
+
+_chain_unroll_max = DEFAULT_CHAIN_UNROLL_MAX
+
+
+def chain_unroll_max() -> int:
+    """Rank-count ceiling up to which the ``bidir`` directional ring
+    chains unroll hop-by-hop; larger worlds take the O(1)-program
+    ``lax.scan`` form (ops/spmd.py ``_ring_allreduce_chain``; bits are
+    identical either way)."""
+    return _chain_unroll_max
+
+
+def set_chain_unroll_max(n) -> None:
+    global _chain_unroll_max
+    _chain_unroll_max = _validated_threshold(
+        n, "chain_unroll_max", minimum=1, unit="rank count")
+
+
 # Intra-group size of the 2-level `hier` allreduce on a single mesh axis.
 # None = derive: the minor axis extent when the communicator was adopted
 # from a multi-axis mesh, else the divisor of nranks closest to sqrt.
@@ -382,7 +487,7 @@ def thresholds_fingerprint():
     return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
-            _hier_group_size)
+            _hier_group_size, _chain_unroll_max)
 
 
 @contextmanager

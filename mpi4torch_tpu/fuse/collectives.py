@@ -93,6 +93,46 @@ def _bucket_codec(comm, bucket, codec, op: int, explicit: bool):
     return bcodec
 
 
+def _plan_bucket(comm, bucket, op: int, codec, algo, *, explicit: bool,
+                 algo_explicit: bool, owns_resolution: bool, size: int,
+                 mode_a: bool):
+    """Per-bucket codec/algorithm resolution — ONE implementation for
+    the blocking fused path and the split-phase overlap scheduler
+    (mpi4torch_tpu.overlap), so the two schedules can never drift on
+    which bucket rides which wire.
+
+    Applies, in order: the facade's per-tensor compression rules on
+    THIS bucket's dtype; the codec/algorithm reconcile (explicit
+    conflicts raise, scope halves yield); backend-side applicability
+    degrades for scope defaults (2-axis backends yield non-native
+    schedules to auto; a non-dividing config.hier_group_size degrades
+    hier/torus to ring); and, for still-unresolved Mode A buckets, the
+    tune selector keyed on this bucket's byte size."""
+    from ..comm import _reconcile_codec_algorithm
+    bcodec = _bucket_codec(comm, bucket, codec, op, explicit)
+    bcodec, balgo = _reconcile_codec_algorithm(
+        bcodec, algo, codec_explicit=explicit, algo_explicit=algo_explicit)
+    if not algo_explicit:
+        if owns_resolution:
+            if balgo not in (None, "ring", "hier", "torus"):
+                balgo = None
+        elif balgo in ("hier", "torus"):
+            from ..tune import resolve_hier_group
+            try:
+                resolve_hier_group(size)
+            except CommError:
+                balgo = "ring"
+    if balgo is None and mode_a:
+        from .. import tune as _tune
+        balgo = _tune.select_auto(
+            collective="allreduce",
+            nbytes=bucket.size * bucket.dtype.itemsize, dtype=bucket.dtype,
+            nranks=size,
+            deterministic=_config.deterministic_reductions(),
+            codec=bcodec)
+    return bcodec, balgo
+
+
 def _pipeline_allreduce(comm, buckets: Sequence, op: int, *,
                         depth: int = 2):
     """Eager overlap scheduler: nonblocking per-bucket sum-allreduce.
@@ -220,6 +260,9 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     mode_a = _is_mode_a(comm)
     explicit = compression is not None
     from ..comm import _resolve_algorithm, _resolve_compression
+    from ..overlap import resolve_overlap
+    overlap_explicit = overlap is not None
+    overlap = resolve_overlap(overlap)
     codec = _resolve_compression(compression)
     algo_explicit = algorithm not in (None, False, "auto")
     owns_resolution = getattr(comm._backend(),
@@ -239,34 +282,63 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     else:
         algo = _resolve_algorithm(algorithm, size)
 
-    if not mode_a and overlap:
-        # Explicit overlap request on the eager backend: the pipeline is
-        # exact-SUM-only, and silently falling back to the blocking
-        # rendezvous path would leave the caller believing they got the
-        # nonblocking schedule — fail loudly instead.  Validated before
-        # the fusion-off early return so the argument check does not
-        # depend on ambient fusion_scope state.
-        if op != C.MPI_SUM:
+    # Which overlap machinery can serve this communicator: the SPMD
+    # mesh (and the 2-axis hier backend, through the generic
+    # compute-at-start handles) take the split-phase scheduler
+    # (mpi4torch_tpu.overlap); the eager runtime takes the
+    # Isend/Irecv pipeline.
+    sched_ok = mode_a or owns_resolution
+    if overlap and not sched_ok:
+        # Overlap request on the eager backend: the pipeline is
+        # exact-SUM/ring-only.  An EXPLICIT overlap= fails loudly on a
+        # conflict — silently falling back to the blocking rendezvous
+        # would leave the caller believing they got the nonblocking
+        # schedule; a scope/process default (config.default_overlap)
+        # degrades to it instead, the standard scope rule.  Validated
+        # before the fusion-off early return so the argument check does
+        # not depend on ambient fusion_scope state.
+        if not overlap_explicit:
+            if (op != C.MPI_SUM or codec is not None
+                    or algo not in (None, "ring")):
+                overlap = False
+        else:
+            if op != C.MPI_SUM:
+                raise CommError(
+                    "the fused overlap pipeline supports MPI_SUM only; "
+                    "pass overlap=False (per-bucket rendezvous "
+                    f"collectives) for {C.op_name(op)} reductions")
+            if codec is not None:
+                raise CommError(
+                    "the fused overlap pipeline is exact-only; compressed "
+                    f"buckets (codec {codec.name!r}"
+                    + ("" if explicit else ", from the active "
+                       "compression_scope/process default") +
+                    ") take the per-bucket rendezvous path — pass "
+                    "overlap=False, or compression=False to pipeline exact")
+            if algo not in (None, "ring"):
+                raise CommError(
+                    "the fused overlap pipeline's gather-fold IS the ring "
+                    f"association; algorithm={algo!r}"
+                    + ("" if algorithm is not None else " (from the active "
+                       "algorithm_scope/process default)") +
+                    " cannot ride it — pass overlap=False for per-bucket "
+                    "rendezvous collectives on that algorithm")
+    if overlap and sched_ok and codec is not None and overlap_explicit:
+        # Split-phase transfers are exact: with the overlap request
+        # explicit, an explicit codec is a hard conflict; a scope codec
+        # is the non-explicit half and yields to the exact split wire.
+        # (With overlap itself a scope default, the codec is honored
+        # instead: compressed buckets take the blocking codec pipeline
+        # in their start slot while exact neighbors ride split-phase —
+        # the per-bucket degrade, mpi4torch_tpu.overlap.scheduler.)
+        if explicit:
             raise CommError(
-                "the fused overlap pipeline supports MPI_SUM only; pass "
-                "overlap=False (per-bucket rendezvous collectives) for "
-                f"{C.op_name(op)} reductions")
-        if codec is not None:
-            raise CommError(
-                "the fused overlap pipeline is exact-only; compressed "
-                f"buckets (codec {codec.name!r}"
-                + ("" if explicit else ", from the active "
-                   "compression_scope/process default") +
-                ") take the per-bucket rendezvous path — pass "
-                "overlap=False, or compression=False to pipeline exact")
-        if algo not in (None, "ring"):
-            raise CommError(
-                "the fused overlap pipeline's gather-fold IS the ring "
-                f"association; algorithm={algo!r}"
-                + ("" if algorithm is not None else " (from the active "
-                   "algorithm_scope/process default)") +
-                " cannot ride it — pass overlap=False for per-bucket "
-                "rendezvous collectives on that algorithm")
+                f"compression={codec.name!r} cannot ride the split-phase "
+                "overlap window — the codec pipeline is a fused "
+                "multi-step collective with no start/wait form; drop "
+                "overlap= (blocking compressed buckets) or compression= "
+                "(exact split-phase buckets)")
+        codec = None
 
     if bb <= 0:
         out = jax.tree.map(
@@ -279,11 +351,31 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     buckets, layout = flatten_buckets(tree, bb)
     nb = layout.num_buckets
 
-    if not mode_a and overlap:
-        reduced = _pipeline_allreduce(comm, buckets, op)
+    if overlap and not sched_ok:
+        from ..overlap import overlap_depth
+        reduced = _pipeline_allreduce(comm, buckets, op,
+                                      depth=overlap_depth(overlap))
         if mean:
             reduced = [b / size for b in reduced]
         return unflatten_buckets(reduced, layout)
+
+    if overlap and sched_ok:
+        # The split-phase overlap scheduler (mpi4torch_tpu.overlap):
+        # windowed Allreduce_start/Wait pairs, sharing THIS function's
+        # per-bucket codec/algorithm plan so the split-phase and
+        # blocking schedules can never drift on which bucket rides
+        # which wire.
+        from ..overlap import overlap_allreduce_tree, overlap_depth
+
+        def plan(i, b):
+            return _plan_bucket(
+                comm, b, op, codec, algo, explicit=explicit,
+                algo_explicit=algo_explicit,
+                owns_resolution=owns_resolution, size=size, mode_a=mode_a)
+
+        return overlap_allreduce_tree(
+            comm, buckets, layout, op, depth=overlap_depth(overlap),
+            mean=mean, plan=plan)
 
     # Phase 1: issue every bucket's reduction.  Exact-SUM buckets on the
     # SPMD mesh take the explicit reduce-scatter half of the ring (the
@@ -295,47 +387,17 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
                 and not _config.deterministic_reductions())
     stage = []
     for i, b in enumerate(buckets):
-        bcodec = _bucket_codec(comm, b, codec, op, explicit)
-        # Per-bucket algorithm pick (the tune selector): an explicit/
-        # scope name was resolved above and pins every bucket; auto
-        # selection keys on THIS bucket's byte size — small tail
+        # Per-bucket codec/algorithm pick (_plan_bucket, shared with the
+        # split-phase scheduler): the facade's dtype degrade, the
+        # codec/algorithm reconcile, backend-side applicability
+        # degrades, and — for still-unresolved Mode A buckets — the
+        # tune selector keyed on THIS bucket's byte size, so small tail
         # buckets take the latency algorithm where the autotuner's
-        # measurements say so, restricted to what the bucket's codec
-        # declares (q8 buckets stay on the ring).  The codec/algorithm
-        # interplay is reconciled PER BUCKET (after the dtype degrade),
-        # exactly like the per-tensor facade: an exact integer bucket
-        # under a compression scope keeps the scope algorithm the
-        # facade would have honored on the bare tensor.
-        from ..comm import _reconcile_codec_algorithm
-        bcodec, balgo = _reconcile_codec_algorithm(
-            bcodec, algo, codec_explicit=explicit,
-            algo_explicit=algo_explicit)
-        if not algo_explicit:
-            # Backend-side applicability the tree-level resolution
-            # cannot see: the facade call below carries the resolved
-            # name as explicit, so apply the scope-default degrade here
-            # — same rule as the bare comm.Allreduce.  On the 2-axis
-            # backend, anything but hier/ring yields to its native
-            # schedule (auto); on a flat axis, a config.hier_group_size
-            # that does not divide THIS communicator degrades hier to
-            # ring.
-            if owns_resolution:
-                if balgo not in (None, "ring", "hier", "torus"):
-                    balgo = None
-            elif balgo in ("hier", "torus"):
-                from ..tune import resolve_hier_group
-                try:
-                    resolve_hier_group(size)
-                except CommError:
-                    balgo = "ring"
-        if balgo is None and mode_a:
-            from .. import tune as _tune
-            balgo = _tune.select_auto(
-                collective="allreduce",
-                nbytes=b.size * b.dtype.itemsize, dtype=b.dtype,
-                nranks=size,
-                deterministic=_config.deterministic_reductions(),
-                codec=bcodec)
+        # measurements say so while q8 buckets stay on the ring.
+        bcodec, balgo = _plan_bucket(
+            comm, b, op, codec, algo, explicit=explicit,
+            algo_explicit=algo_explicit, owns_resolution=owns_resolution,
+            size=size, mode_a=mode_a)
         pair_ok = use_pair and balgo in (None, "ring")
         with bucket_scope("Allreduce_tree", i, nb, codec=bcodec):
             if bcodec is not None or not pair_ok:
@@ -384,7 +446,8 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
 
 
 def fused_reduce_scatter_tree(comm, tree, op: int = C.MPI_SUM, *,
-                              bucket_bytes=None, mean: bool = False):
+                              bucket_bytes=None, mean: bool = False,
+                              overlap=None):
     """Reduce-scatter every leaf of ``tree`` in block buckets: returns
     the tree of this rank's flat per-leaf shards (length
     ``ceil(leaf.size / size)`` each, zero-padded — the ZeRO gradient
@@ -392,13 +455,26 @@ def fused_reduce_scatter_tree(comm, tree, op: int = C.MPI_SUM, *,
     ``Reduce_scatter`` per bucket (→ one native ``psum_scatter`` under
     SPMD).  ``mean=True`` divides each shard bucket by ``comm.size``
     once (MPI_SUM only).  Always exact (the ZeRO internals are pinned
-    exact; see compress docs)."""
+    exact; see compress docs).
+
+    ``overlap`` (None → the :func:`config.overlap_scope` / process
+    default): truthy under the SPMD backend runs the split-phase
+    window (:func:`mpi4torch_tpu.overlap.overlap_reduce_scatter_tree`)
+    — up to ``depth`` bucket reduce-scatters in flight, bit-identical
+    to the blocking form."""
     if mean and op != C.MPI_SUM:
         raise CommError(
             f"mean=True is the rank-mean of an MPI_SUM reduction; got "
             f"{C.op_name(op)}")
     bb = _resolve_bucket_bytes(bucket_bytes)
     size = comm.size
+    from ..overlap import overlap_depth, resolve_overlap
+    overlap = resolve_overlap(overlap)
+    if overlap and bb > 0 and _is_mode_a(comm):
+        from ..overlap import overlap_reduce_scatter_tree
+        return overlap_reduce_scatter_tree(
+            comm, tree, op, bucket_bytes=bb, depth=overlap_depth(overlap),
+            mean=mean)
     if bb <= 0:
         def per_leaf(g):
             flat = jnp.asarray(g).reshape(-1)
@@ -417,16 +493,30 @@ def fused_reduce_scatter_tree(comm, tree, op: int = C.MPI_SUM, *,
     return unflatten_shard_rows(rows, layout)
 
 
-def fused_allgather_tree(comm, shard_tree, template, *, bucket_bytes=None):
+def fused_allgather_tree(comm, shard_tree, template, *, bucket_bytes=None,
+                         overlap=None):
     """Gather a tree of flat per-leaf shards (the output shape of
     :func:`fused_reduce_scatter_tree` /
     :func:`~mpi4torch_tpu.parallel.zero.zero3_shard_params`) back into
     full leaves shaped like ``template``, with ONE ``Allgather`` per
     bucket.  Differentiable: the adjoint is the fused per-bucket
     reduce-scatter of the cotangents (the ZeRO-3 wire pattern).  Always
-    exact — parameter shards must not ride a lossy codec."""
+    exact — parameter shards must not ride a lossy codec.
+
+    ``overlap`` (None → the :func:`config.overlap_scope` / process
+    default): truthy under the SPMD backend runs the double-buffered
+    parameter *prefetch* (:func:`mpi4torch_tpu.overlap.
+    prefetch_allgather_tree`) — bucket ``k+1``'s all-gather starts
+    before bucket ``k``'s Wait, bit-identical to the blocking form."""
     bb = _resolve_bucket_bytes(bucket_bytes)
     size = comm.size
+    from ..overlap import overlap_depth, resolve_overlap
+    overlap = resolve_overlap(overlap)
+    if overlap and bb > 0 and _is_mode_a(comm):
+        from ..overlap import prefetch_allgather_tree
+        return prefetch_allgather_tree(
+            comm, shard_tree, template, bucket_bytes=bb,
+            depth=overlap_depth(overlap))
     if bb <= 0:
         def per_leaf(shard, t):
             full = comm.Allgather(shard, 0, compression=False)

@@ -94,12 +94,28 @@ class _HandleState:
 
 
 @dataclass
+class _CollState:
+    """One posted split-phase collective (mpi4torch_tpu.overlap):
+    phase 1 (the *start*) already issued its communication; ``complete``
+    finishes phase 2 at Wait time (``None`` = the start emitted the
+    whole collective and Wait is a barrier-tied completion point)."""
+    opname: str               # "Allreduce" | "Reduce_scatter" | "Allgather"
+    complete: Any = None      # callable(phase1_value) -> final value
+    waited: bool = False
+
+
+@dataclass
 class SpmdContext:
     """An active SPMD trace region bound to a mesh axis."""
     axis_name: str
     size: int
     pending: List[_PendingP2P] = field(default_factory=list)
     handles: Dict[int, _HandleState] = field(default_factory=dict)
+    # Split-phase collective handles (mpi4torch_tpu.overlap): keyed by
+    # the phase-1 buffer tracer id, like the p2p handle table; the
+    # pending list backs the un-waited-at-region-exit guard.
+    coll_handles: Dict[int, _CollState] = field(default_factory=dict)
+    coll_pending: List[_CollState] = field(default_factory=list)
 
 
 _SPMD_CTX: contextvars.ContextVar[Optional[SpmdContext]] = \
@@ -795,12 +811,13 @@ def _hier_allreduce_value(ctx: SpmdContext, x, op: int):
 # ---------------------------------------------------------------------------
 
 
-# Worlds up to this size unroll the bidir chains hop-by-hop (distinct
-# permute ops, maximal scheduling freedom and the HLO-census surface);
-# larger worlds roll each phase into a lax.scan so the compiled program
-# does not grow with the rank count (a 256-rank pod would otherwise
-# emit ~1000 permute ops per bidir allreduce).
-_CHAIN_UNROLL_MAX = 32
+# The unroll-vs-scan threshold of the bidir chains lives in config.py
+# (config.chain_unroll_max, promoted from the module constant here —
+# ISSUE 5 satellite, matching the ISSUE 3 threshold-promotion pattern):
+# worlds up to that size unroll hop-by-hop (distinct permute ops, the
+# HLO-census surface); larger worlds roll each phase into a lax.scan so
+# the compiled program stays O(1) in the rank count.  run_spmd keys its
+# jit cache on the thresholds fingerprint, so overriding it retraces.
 
 
 def _ring_allreduce_chain(ctx: SpmdContext, flat, op: int, direction: int):
@@ -818,10 +835,10 @@ def _ring_allreduce_chain(ctx: SpmdContext, flat, op: int, direction: int):
     segments ``N-1`` more hops.  Returns the unpadded flat result.
 
     Small worlds unroll the 2(N-1) hops (each permute a distinct HLO op
-    — the census surface); past ``_CHAIN_UNROLL_MAX`` ranks each phase
-    rolls into a ``lax.scan`` so the compiled program stays O(1) in the
-    world size (the wire schedule is identical — one chunk-sized
-    permute per step, same segment walk)."""
+    — the census surface); past ``config.chain_unroll_max()`` ranks
+    each phase rolls into a ``lax.scan`` so the compiled program stays
+    O(1) in the world size (the wire schedule is identical — one
+    chunk-sized permute per step, same segment walk)."""
     n = ctx.size
     axis = ctx.axis_name
     idx = lax.axis_index(axis)
@@ -845,7 +862,8 @@ def _ring_allreduce_chain(ctx: SpmdContext, flat, op: int, direction: int):
         mine = lax.dynamic_index_in_dim(segs, j, axis=0, keepdims=False)
         return C.combine2(op, recv, mine), None
 
-    if n <= _CHAIN_UNROLL_MAX:
+    unroll_max = _config.chain_unroll_max()
+    if n <= unroll_max:
         for t in range(n - 1):
             part, _ = rs_step(part, t)
     else:
@@ -863,7 +881,7 @@ def _ring_allreduce_chain(ctx: SpmdContext, flat, op: int, direction: int):
             acc, cur, (idx - d * t) % n, axis=0)
         return (cur, acc), None
 
-    if n <= _CHAIN_UNROLL_MAX:
+    if n <= unroll_max:
         carry = (part, out)
         for t in range(n - 1):
             carry, _ = ag_step(carry, t)
@@ -1556,6 +1574,140 @@ def wait(ctx: SpmdContext, handle: List):
 
 
 # ---------------------------------------------------------------------------
+# Split-phase collectives (mpi4torch_tpu.overlap): Allreduce_start /
+# Reduce_scatter_start / Allgather_start + collective Wait.
+#
+# The start issues the collective's first (or only) phase at its trace
+# position; the Wait completes it — possibly much later, with user
+# compute in between.  Because StableHLO preserves trace order and the
+# Wait ties its completion through a differentiable optimization_barrier
+# (onto the handle's descriptor slot, where JoinDummiesHandle chains
+# land), XLA's latency-hiding scheduler is free to slide the collective
+# under everything issued between start and Wait — the SPMD analogue of
+# the eager runtime's Isend/Irecv/WaitHandle machinery, with the same
+# misuse guards (double-Wait raises; an un-waited handle at region exit
+# raises, the collective analogue of an unmatched Isend).
+#
+# AD transparency is compositional: both phases are the module's own
+# custom_vjp collectives glued by differentiable barriers, so the
+# backward pass is itself split-phase with the wait chain REVERSED —
+# the adjoint of the Wait's all-gather (a reduce-scatter of the
+# cotangents) runs at the Wait's position in the reversed program, i.e.
+# FIRST, and the adjoint of the start's reduce-scatter (an all-gather)
+# runs last: the deadlock-free ordering that JoinDummiesHandle chaining
+# provides on the eager path falls out of the transpose here.
+# ---------------------------------------------------------------------------
+
+
+def _register_coll(ctx: SpmdContext, opname: str, value, complete=None
+                   ) -> List:
+    """Post a split-phase collective: wrap the phase-1 value in the raw
+    3-tensor handle ``[descriptor, buffer, loopthrough]`` (the eager
+    WaitHandle layout) and record the completion state keyed by the
+    buffer tracer — the same identity scheme as the p2p handles."""
+    buf = _fresh(value)
+    desc = _opt_barrier(
+        (jnp.zeros(_SPMD_DESC_LEN, jnp.float32), buf))[0]
+    state = _CollState(opname=opname, complete=complete)
+    ctx.coll_handles[id(buf)] = state
+    ctx.coll_pending.append(state)
+    return [desc, buf, buf]
+
+
+def allreduce_start(ctx: SpmdContext, x, op: int, algorithm=None,
+                    algorithm_explicit: bool = False) -> List:
+    """Split-phase SPMD Allreduce, phase 1.
+
+    Ring-SUM outside deterministic mode issues the reduce-scatter half
+    here and leaves the all-gather half to the Wait — the two phases of
+    a ring allreduce straddling whatever the user computes in between
+    (exactly the pair the fused bucket path stages, fuse/collectives.py,
+    so split-phase and fused-blocking buckets are bit-identical).  Every
+    other form — deterministic mode, non-SUM ops, non-ring algorithms —
+    computes the SAME fold as the blocking op entirely in phase 1 (the
+    blocking value, only scheduled earlier), and the Wait is a
+    barrier-tied completion point; bit-identity with the blocking form
+    holds by construction in every case."""
+    x = jnp.asarray(x)
+    if algorithm is None:
+        algorithm = _auto_allreduce_algorithm(ctx, x)
+    n = ctx.size
+    use_pair = (op == C.MPI_SUM and n > 1
+                and not _config.deterministic_reductions()
+                and algorithm in (None, "ring"))
+    if not use_pair:
+        val = allreduce(ctx, x, op, algorithm,
+                        algorithm_explicit=algorithm_explicit)
+        return _register_coll(ctx, "Allreduce", val)
+
+    shape = x.shape
+    total = x.size
+    seg = -(-total // n)
+    flat = x.reshape(-1)
+    if seg * n != total:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros(seg * n - total, x.dtype)])
+    part = reduce_scatter(ctx, flat.reshape(n, seg), op, 0)
+
+    def complete(val):
+        full = allgather(ctx, val, 0)
+        return full.reshape(-1)[:total].reshape(shape)
+
+    return _register_coll(ctx, "Allreduce", part, complete)
+
+
+def reduce_scatter_start(ctx: SpmdContext, x, op: int,
+                         scatteraxis: int) -> List:
+    """Split-phase SPMD Reduce_scatter: the single native collective is
+    issued here (one ``psum_scatter`` for SUM — the ZeRO gradient
+    primitive); the Wait is the barrier-tied completion point that pins
+    where its result may be consumed.  Same value and bits as the
+    blocking op — only the schedule differs."""
+    val = reduce_scatter(ctx, x, op, scatteraxis)
+    return _register_coll(ctx, "Reduce_scatter", val)
+
+
+def allgather_start(ctx: SpmdContext, x, gatheraxis: int) -> List:
+    """Split-phase SPMD Allgather: the ``all_gather`` is issued here —
+    this is the ZeRO-3 parameter *prefetch* primitive: start the gather
+    of shard k+1 while layer k's forward is still computing, Wait it
+    where the parameters are consumed.  Same value and bits as the
+    blocking op."""
+    val = allgather(ctx, x, gatheraxis)
+    return _register_coll(ctx, "Allgather", val)
+
+
+_NOT_COLL = object()
+
+
+def collective_wait(ctx: SpmdContext, handle: List):
+    """Complete a split-phase collective handle; returns ``_NOT_COLL``
+    when the handle does not belong to the collective table (the caller
+    falls through to the p2p Wait).  Guards mirror the p2p trio's:
+    exactly-once completion (a double Wait raises
+    :class:`BifurcationError`), and region exit raises on un-waited
+    handles (see :class:`_bind_spmd`)."""
+    desc, buf, loop = handle
+    state = ctx.coll_handles.get(id(buf))
+    if state is None:
+        return _NOT_COLL
+    if state.waited:
+        raise BifurcationError(
+            "Detected bifurcation in Wait handle usage: this split-phase "
+            f"{state.opname} was already waited on (a WaitHandle "
+            "completes exactly once)")
+    state.waited = True
+    ctx.coll_pending.remove(state)
+    # Tie the phase-1 value to the descriptor chain so JoinDummiesHandle
+    # dependencies (and the scheduler's cross-bucket ordering ties)
+    # survive into the compiled program — the p2p Wait's discipline.
+    val = _opt_barrier((buf, desc))[0]
+    if state.complete is not None:
+        val = state.complete(val)
+    return val
+
+
+# ---------------------------------------------------------------------------
 # Backend + harness
 # ---------------------------------------------------------------------------
 
@@ -1614,7 +1766,24 @@ class SpmdBackend:
         return irecv(self._ctx, x, source, tag)
 
     def wait(self, handle):
+        # Split-phase collective handles share the Wait surface with the
+        # p2p trio (one completion verb, like MPI_Wait): consult the
+        # collective table first, fall through to the p2p machinery.
+        out = collective_wait(self._ctx, handle)
+        if out is not _NOT_COLL:
+            return out
         return wait(self._ctx, handle)
+
+    def allreduce_start(self, x, op, algorithm=None,
+                        algorithm_explicit=False):
+        return allreduce_start(self._ctx, x, op, algorithm,
+                               algorithm_explicit=algorithm_explicit)
+
+    def reduce_scatter_start(self, x, op, scatteraxis):
+        return reduce_scatter_start(self._ctx, x, op, scatteraxis)
+
+    def allgather_start(self, x, gatheraxis):
+        return allgather_start(self._ctx, x, gatheraxis)
 
 
 class _bind_spmd:
@@ -1637,6 +1806,15 @@ class _bind_spmd:
                 f"at the end of the SPMD region: {leftover} — every Isend "
                 "needs a complementary Irecv with the same tag (under MPI "
                 "this program would hang)"
+            )
+        if exc_type is None and self.ctx.coll_pending:
+            leftover = ", ".join(
+                f"{s.opname}_start" for s in self.ctx.coll_pending)
+            raise DeadlockError(
+                f"un-waited split-phase collective handle(s) at the end "
+                f"of the SPMD region: {leftover} — every *_start needs a "
+                "matching Wait (the result exists only at the Wait; "
+                "dropping the handle silently discards the collective)"
             )
         return False
 
@@ -1879,6 +2057,17 @@ def comm_from_mesh(mesh, axis_name):
                 "program would hang)",
                 file=sys.stderr,
             )
+        if ctx.coll_pending:
+            import sys
+            leftover = ", ".join(
+                f"{s.opname}_start" for s in ctx.coll_pending)
+            print(
+                "mpi4torch_tpu WARNING: SPMD trace region ended with "
+                f"un-waited split-phase collective handle(s): {leftover} "
+                "— every *_start needs a matching Wait (the result "
+                "exists only at the Wait)",
+                file=sys.stderr,
+            )
 
     def resolver():
         ctx = current_spmd_context()
@@ -1970,7 +2159,7 @@ def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
         mesh = Mesh(np.asarray(devs[:n]), (axis_name,))
     size = mesh.shape[axis_name]
 
-    def wrapped(det, comp, bb, algo, _tune_key, *args):
+    def wrapped(det, comp, bb, algo, ovl, _tune_key, *args):
         # _tune_key (thresholds fingerprint + tune cache generation) is
         # jit-cache-key-only: the values are read inside the trace via
         # config/tune, the static arg just forces a retrace when they
@@ -1978,34 +2167,37 @@ def run_spmd(fn, nranks: Optional[int] = None, mesh=None,
         ctx = SpmdContext(axis_name=axis_name, size=size)
         with _bind_spmd(ctx), _config.deterministic_mode(det), \
                 _config.compression_scope(comp), \
-                _config.fusion_scope(bb), _config.algorithm_scope(algo):
+                _config.fusion_scope(bb), _config.algorithm_scope(algo), \
+                _config.overlap_scope(ovl):
             out = fn(*args)
         return jax.tree.map(lambda y: jnp.expand_dims(y, 0), out)
 
-    def sm(det, comp, bb, algo, tk, *args):
-        return shard_map(lambda *a: wrapped(det, comp, bb, algo, tk, *a),
-                         mesh=mesh, in_specs=P(), out_specs=P(axis_name),
-                         check_vma=False)(*args)
+    def sm(det, comp, bb, algo, ovl, tk, *args):
+        return shard_map(
+            lambda *a: wrapped(det, comp, bb, algo, ovl, tk, *a),
+            mesh=mesh, in_specs=P(), out_specs=P(axis_name),
+            check_vma=False)(*args)
 
     if jit:
-        jitted = jax.jit(sm, static_argnums=(0, 1, 2, 3, 4))
+        jitted = jax.jit(sm, static_argnums=(0, 1, 2, 3, 4, 5))
     else:
         jitted = sm
 
     def call(*args):
         # The deterministic-reductions flag, the compression default,
-        # the fusion bucket size, the algorithm default, and the
-        # schedule thresholds + tune-cache generation are read at *call*
-        # time and made part of the jit cache key (static args), so
-        # toggling any of them — or the autotuner recording a new
-        # winner — retraces instead of silently reusing the old
-        # lowering.
+        # the fusion bucket size, the algorithm default, the overlap
+        # policy, and the schedule thresholds + tune-cache generation
+        # are read at *call* time and made part of the jit cache key
+        # (static args), so toggling any of them — or the autotuner
+        # recording a new winner — retraces instead of silently reusing
+        # the old lowering.
         from .. import tune as _tune
 
         return jitted(_config.deterministic_reductions(),
                       _config.default_compression(),
                       _config.default_bucket_bytes(),
                       _config.default_algorithm(),
+                      _config.default_overlap(),
                       (_config.thresholds_fingerprint(),
                        _tune.generation()), *args)
 

@@ -1,0 +1,218 @@
+"""Split-phase nonblocking collectives + the overlap scheduler.
+
+The paper's core nonblocking machinery (``WaitHandle``, ``JoinDummies``,
+``JoinDummiesHandle``) existed only on the eager Mode B path; Mode A
+(SPMD) collectives were all blocking, so every Allreduce/bucket pair
+serialized against the surrounding compute.  This package brings
+AD-transparent *split-phase* collectives to Mode A — ``comm.
+Allreduce_start`` / ``Reduce_scatter_start`` / ``Allgather_start``
+return an :class:`SpmdWaitHandle` mirroring the eager ``WaitHandle``
+API, completed by the same ``comm.Wait`` verb — and the scheduler
+(:mod:`.scheduler`) that exploits them to hide ZeRO/DP/PP communication
+behind compute:
+
+* **split-phase ops** (ops/spmd.py): the *start* issues the
+  collective's first phase at its trace position (ring-SUM: the
+  reduce-scatter half; everything else: the whole blocking fold) and
+  the *Wait* completes it through a differentiable
+  ``optimization_barrier`` — compute issued in between can hide the
+  transfer, and the HLO start/done straddles it ("The Big Send-off",
+  PAPERS.md: after algorithm choice, the dominant win is overlap; GC3
+  makes collective scheduling a first-class compiler optimization).
+  The backward pass is itself split-phase with the wait chain
+  REVERSED — the SPMD analogue of ``JoinDummiesHandle``'s
+  deadlock-free chaining.
+* **overlap scheduler** (:mod:`.scheduler`): consumes the fused bucket
+  layouts (mpi4torch_tpu.fuse) and keeps a configurable window of
+  bucket collectives in flight — bucket ``i``'s reduce-scatter launches
+  while bucket ``i+1`` is still being started, and a double-buffered
+  ZeRO parameter all-gather *prefetch* starts gathering shard ``k+1``
+  while layer ``k``'s consumer compute is still ahead of its Wait.
+  Wired into ``parallel/zero.py`` (``zero_step``/``zero3_params``),
+  ``parallel/dp.py`` (``all_average_tree(overlap=...)``) and the
+  fused tree facade (``comm.Allreduce_tree(..., overlap=...)``).
+* **knobs**: ``config.default_overlap()`` / ``config.overlap_scope``
+  (jit-cache-keyed by ``run_spmd`` like the det/compression/fusion
+  scopes); ``overlap=True`` means 2 collectives in flight, an
+  ``int >= 1`` sets the window depth.
+
+Mode A and Mode B stay bit-identical under ``deterministic_mode``: the
+split-phase form computes the same fold as the blocking form, only
+scheduled differently (regression-tested bitwise and HLO-censused in
+tests/test_overlap.py).  Composition follows the house degrade/raise
+rule: split-phase transfers are exact — an explicit overlap request
+plus an explicit codec raises, scope defaults degrade (a compressed
+bucket takes the blocking codec pipeline while its exact neighbors
+ride split-phase).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from .. import config as _config
+from ..comm import WaitHandle
+from ..ops.eager import join_dummies as _join_dummies
+from ..runtime import BifurcationError
+
+__all__ = [
+    "SPLIT_PHASE_FORMS",
+    "SpmdWaitHandle",
+    "allreduce_start",
+    "reduce_scatter_start",
+    "allgather_start",
+    "complete_generic",
+    "resolve_overlap",
+    "overlap_depth",
+    "overlap_allreduce_tree",
+    "overlap_reduce_scatter_tree",
+    "prefetch_allgather_tree",
+    "scheduled_exposure",
+]
+
+# Every split-phase collective form the facade exposes (as
+# `<Form>_start` methods).  tests/test_overlap.py carries a sync guard
+# in the test_tune registry-guard mold: each form here must have HLO
+# census coverage, so a future *_start shipped without census tests
+# fails CI.
+SPLIT_PHASE_FORMS = ("Allreduce", "Reduce_scatter", "Allgather")
+
+_DESC_LEN = 8
+
+
+@dataclass
+class _SplitState:
+    """Completion state of a split-phase handle on backends without a
+    trace context (eager rank-threads, the 2-axis hier communicator,
+    the size-1 default world): the blocking value was computed at start
+    time; Wait is the exactly-once completion point."""
+    opname: str
+    result: Any
+    waited: bool = False
+
+
+class SpmdWaitHandle(WaitHandle):
+    """Wait handle of a split-phase collective — the SPMD counterpart
+    of the eager :class:`~mpi4torch_tpu.WaitHandle`, with the identical
+    API surface: ``.dummy`` for :func:`~mpi4torch_tpu.JoinDummies`,
+    :func:`~mpi4torch_tpu.JoinDummiesHandle` composes (dummies land on
+    the descriptor slot and the Wait ties them into the completion
+    barrier), and ``comm.Wait`` completes it exactly once.
+
+    Under the SPMD mesh backend the completion state lives in the trace
+    context (keyed by the phase-1 buffer tracer, like the p2p handles),
+    so double-Wait and handle-splicing guards fire at trace time and an
+    un-waited handle raises when the region closes.  On the other
+    backends the handle carries its own :class:`_SplitState`, shared
+    across :func:`JoinDummiesHandle` copies so a double Wait through
+    either copy still raises."""
+
+    def __init__(self, raw_handle: List, state: _SplitState = None):
+        super().__init__(raw_handle)
+        self._split_state = state
+
+    def _with_raw(self, raw_handle: List) -> "SpmdWaitHandle":
+        return SpmdWaitHandle(raw_handle, self._split_state)
+
+
+def _is_spmd_backend(backend) -> bool:
+    from ..ops.spmd import SpmdBackend
+    return isinstance(backend, SpmdBackend)
+
+
+def _start_generic(opname: str, value) -> SpmdWaitHandle:
+    """Compute-at-start split-phase form for backends without a trace
+    context: the blocking collective already ran (``value``); the
+    handle's Wait returns it through a dependency-carrying JoinDummies,
+    bit-identical to the blocking op."""
+    desc = _join_dummies(jnp.zeros(_DESC_LEN, jnp.float32),
+                         [jnp.asarray(value).reshape(-1)[:1]])
+    state = _SplitState(opname=opname, result=value)
+    return SpmdWaitHandle([desc, value, value], state)
+
+
+def complete_generic(handle: SpmdWaitHandle):
+    """Complete a state-carrying split-phase handle (``comm.Wait``
+    dispatches here for non-SPMD backends)."""
+    state = handle._split_state
+    if state.waited:
+        raise BifurcationError(
+            "Detected bifurcation in Wait handle usage: this split-phase "
+            f"{state.opname} was already waited on (a WaitHandle "
+            "completes exactly once)")
+    state.waited = True
+    # Tie through the descriptor so JoinDummiesHandle chains survive.
+    return _join_dummies(state.result, [handle._handle[0]])
+
+
+def allreduce_start(comm, tensor, op: int, compression=None,
+                    algorithm=None) -> SpmdWaitHandle:
+    """Facade body of ``comm.Allreduce_start``: one resolution path with
+    the blocking :meth:`~mpi4torch_tpu.MPI_Communicator.Allreduce`
+    (``MPI_Communicator._allreduce_plan``), then the split-phase rule —
+    split transfers are exact, so an explicit codec raises and a scope
+    default degrades to the exact wire."""
+    backend, codec, algo, algo_explicit = comm._allreduce_plan(
+        tensor, op, compression, algorithm)
+    if codec is not None:
+        if compression is not None:
+            raise ValueError(
+                f"compression={codec.name!r} cannot ride a split-phase "
+                "Allreduce — the codec pipeline is a fused multi-step "
+                "collective with no start/wait form; use the blocking "
+                "Allreduce, or compression=False to split-phase exact")
+        codec = None  # scope default yields: exact split-phase wire
+    if _is_spmd_backend(backend):
+        raw = backend.allreduce_start(tensor, op, algorithm=algo,
+                                      algorithm_explicit=algo_explicit)
+        return SpmdWaitHandle(raw)
+    val = backend.allreduce(tensor, op, algorithm=algo,
+                            algorithm_explicit=algo_explicit)
+    return _start_generic("Allreduce", val)
+
+
+def reduce_scatter_start(comm, tensor, op: int,
+                         scatteraxis: int) -> SpmdWaitHandle:
+    """Facade body of ``comm.Reduce_scatter_start``."""
+    backend = comm._backend()
+    if _is_spmd_backend(backend):
+        return SpmdWaitHandle(
+            backend.reduce_scatter_start(tensor, op, scatteraxis))
+    return _start_generic(
+        "Reduce_scatter", backend.reduce_scatter(tensor, op, scatteraxis))
+
+
+def allgather_start(comm, tensor, gatheraxis: int) -> SpmdWaitHandle:
+    """Facade body of ``comm.Allgather_start``."""
+    backend = comm._backend()
+    if _is_spmd_backend(backend):
+        return SpmdWaitHandle(
+            backend.allgather_start(tensor, gatheraxis))
+    return _start_generic(
+        "Allgather", backend.allgather(tensor, gatheraxis))
+
+
+def resolve_overlap(overlap):
+    """Resolve an ``overlap=`` argument: ``None`` defers to the
+    :func:`mpi4torch_tpu.config.overlap_scope` / process default;
+    explicit values are validated (``True``/``False``/depth ``>= 1``)."""
+    if overlap is None:
+        return _config.default_overlap()
+    return _config._validated_overlap(overlap)
+
+
+def overlap_depth(value, default: int = 2) -> int:
+    """Prefetch window depth of a truthy overlap value (``True`` → the
+    double-buffered default of 2)."""
+    return default if value is True else max(int(value), 1)
+
+
+# Scheduler entry points (public API; the fused tree facade and the
+# parallel/ helpers route through these).
+from .scheduler import (overlap_allreduce_tree,            # noqa: E402
+                        overlap_reduce_scatter_tree,
+                        prefetch_allgather_tree)
+from .census import scheduled_exposure                     # noqa: E402

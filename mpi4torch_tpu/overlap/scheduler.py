@@ -1,0 +1,179 @@
+"""The overlap scheduler: windowed split-phase bucket collectives.
+
+Consumes the fused bucket layouts of :mod:`mpi4torch_tpu.fuse` and
+replaces the blocking per-bucket collectives with *start/wait pairs*
+held in a sliding window of ``depth`` buckets: bucket ``i``'s collective
+is started as soon as its flat buffer exists, and its Wait is issued
+only after bucket ``i + depth - 1``'s start — so at any point up to
+``depth`` collectives are in flight, with every bucket's completion
+point tied (via :func:`~mpi4torch_tpu.JoinDummiesHandle` onto the
+youngest start) so neither XLA nor the autodiff transpose can collapse
+the window.  The backward pass needs no extra scheduling: each phase is
+a ``custom_vjp`` collective glued by differentiable barriers, so the
+adjoint program is the same window with the wait chain reversed.
+
+Three shapes, one discipline:
+
+* :func:`overlap_allreduce_tree` — the DP gradient primitive
+  (``comm.Allreduce_tree(..., overlap=...)`` routes here under the
+  SPMD backend): per bucket, the reduce-scatter half starts early and
+  the all-gather half completes late.
+* :func:`overlap_reduce_scatter_tree` — the ZeRO-1/3 gradient-shard
+  primitive (``zero_step``): one ``Reduce_scatter_start`` per block
+  bucket, windowed.
+* :func:`prefetch_allgather_tree` — the ZeRO-3 parameter *prefetch*
+  (``zero3_params``): double-buffered ``Allgather_start`` — the gather
+  of shard bucket ``k+1`` is issued before bucket ``k``'s Wait, so the
+  next layer's parameters are already on the wire while the current
+  layer's consumer compute is still between the Wait and its use.
+
+Per-bucket composition follows the house rule: a bucket whose resolved
+codec cannot split (every codec — the compressed pipeline is a fused
+multi-step collective) takes the *blocking* compressed path at its
+start slot while its exact neighbors ride split-phase; explicit
+conflicts raise at the tree level (fuse/collectives.py).  Algorithm
+picks compose per bucket exactly as on the blocking path — a non-ring
+schedule runs whole in its phase 1, keeping its tuned wire while later
+buckets' starts still slide past it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants as C
+from ..utils.profiling import bucket_scope
+
+
+def _windowed(nb: int, depth: int, start, finish) -> None:
+    """Run ``start(i)`` / ``finish(i)`` over ``nb`` buckets with up to
+    ``depth`` starts ahead of the oldest unfinished bucket."""
+    depth = max(int(depth), 1)
+    for i in range(nb):
+        start(i)
+        j = i - (depth - 1)
+        if j >= 0:
+            finish(j)
+    for j in range(max(nb - depth + 1, 0), nb):
+        finish(j)
+
+
+class _Window:
+    """Shared start/wait bookkeeping: handles per bucket, plus the
+    youngest started handle so each Wait can be order-tied after it."""
+
+    def __init__(self, comm, op: str, nb: int):
+        self.comm = comm
+        self.op = op
+        self.nb = nb
+        self.handles = {}
+        self.results = [None] * nb
+        self.youngest = None
+
+    def started(self, i: int, handle) -> None:
+        self.handles[i] = handle
+        self.youngest = handle
+
+    def finish(self, i: int) -> None:
+        h = self.handles.pop(i, None)
+        if h is None:
+            return  # blocking bucket (codec path): completed at start
+        if self.youngest is not None and self.youngest is not h:
+            # Pin the window: bucket i's completion cannot be hoisted
+            # before the youngest start — the cross-bucket ordering tie
+            # that keeps >= depth collectives in flight (and, reversed
+            # by the transpose, orders the backward chain).
+            from ..comm import JoinDummiesHandle
+            h = JoinDummiesHandle(h, [self.youngest.dummy])
+        with bucket_scope(self.op, i, self.nb, phase="wait"):
+            self.results[i] = self.comm.Wait(h)
+
+
+def overlap_allreduce_tree(comm, buckets: Sequence, layout, op: int, *,
+                           depth: int = 2, mean: bool = False,
+                           plan=None):
+    """Windowed split-phase allreduce over pre-flattened buckets.
+
+    ``plan(i, bucket) -> (codec, algorithm)`` is the per-bucket
+    resolution the fused tree path already computes
+    (fuse/collectives.py); compressed buckets take the blocking codec
+    pipeline in their start slot, exact buckets ride start/wait pairs.
+    Returns the reduced bucket list (``mean`` folds the rank-mean into
+    one post-wait scale per bucket)."""
+    from ..fuse.bucketing import unflatten_buckets
+
+    nb = len(buckets)
+    size = comm.size
+    win = _Window(comm, "Allreduce_tree", nb)
+
+    def start(i):
+        b = buckets[i]
+        bcodec, balgo = plan(i, b) if plan is not None else (None, None)
+        if bcodec is not None:
+            with bucket_scope("Allreduce_tree", i, nb, codec=bcodec):
+                win.results[i] = comm.Allreduce(b, op, compression=bcodec,
+                                                algorithm=balgo)
+            return
+        with bucket_scope("Allreduce_tree", i, nb, phase="start"):
+            win.started(i, comm.Allreduce_start(b, op, compression=False,
+                                                algorithm=balgo))
+
+    _windowed(nb, depth, start, win.finish)
+    reduced = [r / size if mean else r for r in win.results]
+    return unflatten_buckets(reduced, layout)
+
+
+def overlap_reduce_scatter_tree(comm, tree, op: int, *, bucket_bytes: int,
+                                depth: int = 2, mean: bool = False):
+    """Windowed split-phase block-bucket reduce-scatter — the ZeRO
+    gradient sharding of :func:`mpi4torch_tpu.fuse.
+    fused_reduce_scatter_tree` with up to ``depth`` ``psum_scatter``
+    collectives in flight.  Always exact (ZeRO internals are pinned
+    exact); bit-identical to the blocking form."""
+    from ..fuse.bucketing import flatten_shard_buckets, unflatten_shard_rows
+
+    size = comm.size
+    buckets, layout = flatten_shard_buckets(tree, size, bucket_bytes)
+    nb = layout.num_buckets
+    win = _Window(comm, "Reduce_scatter_tree", nb)
+
+    def start(i):
+        with bucket_scope("Reduce_scatter_tree", i, nb, phase="start"):
+            win.started(i, comm.Reduce_scatter_start(buckets[i], op, 0))
+
+    _windowed(nb, depth, start, win.finish)
+    rows = [r.reshape(-1) / size if mean else r.reshape(-1)
+            for r in win.results]
+    return unflatten_shard_rows(rows, layout)
+
+
+def prefetch_allgather_tree(comm, shard_tree, template, *,
+                            bucket_bytes: int, depth: int = 2):
+    """Double-buffered ZeRO-3 parameter all-gather prefetch: bucket
+    ``k+1``'s ``Allgather_start`` is issued before bucket ``k``'s Wait,
+    so while the consumer (layer ``k``'s forward, downstream of the
+    Wait) runs, the next shard bucket is already on the wire.  The
+    adjoint is the same window of reduce-scatters in reverse — ZeRO-3's
+    gather-params/reduce-scatter-grads wire pattern, now overlapped in
+    both directions.  Always exact; bit-identical to the blocking
+    :func:`mpi4torch_tpu.fuse.fused_allgather_tree`."""
+    from ..fuse.bucketing import (flatten_shard_rows, shard_layout,
+                                  unflatten_gathered)
+
+    size = comm.size
+    layout = shard_layout(template, size, bucket_bytes)
+    rows = flatten_shard_rows(shard_tree, layout)
+    nb = layout.num_buckets
+    win = _Window(comm, "Allgather_tree", nb)
+
+    def start(i):
+        with bucket_scope("Allgather_tree", i, nb, phase="start"):
+            win.started(i, comm.Allgather_start(rows[i], 0))
+
+    _windowed(nb, depth, start, win.finish)
+    blocks = [full.reshape(size, -1) for full in win.results]
+    out = unflatten_gathered(blocks, layout)
+    return jax.tree.map(lambda x, t: x.astype(t.dtype), out, template)

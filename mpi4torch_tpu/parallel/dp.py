@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from ..constants import MPI_SUM
 
 
-def all_average_tree(comm, tree, bucket_bytes=None):
+def all_average_tree(comm, tree, bucket_bytes=None, overlap=None):
     """Allreduce-average every leaf of a pytree.
 
     The DP lock-step primitive: forward is the identity on replicated
@@ -30,9 +30,17 @@ def all_average_tree(comm, tree, bucket_bytes=None):
     division per leaf.  Results stay bitwise lock-step across ranks
     (every rank decodes the same gathered bucket), and the eager backend
     is bit-identical to the historical per-leaf form.  Opt out with
-    ``bucket_bytes=0`` or ``config.fusion_scope(0)``."""
+    ``bucket_bytes=0`` or ``config.fusion_scope(0)``.
+
+    ``overlap`` (None → the :func:`mpi4torch_tpu.config.overlap_scope`
+    / process default): truthy selects the split-phase overlap
+    scheduler (:mod:`mpi4torch_tpu.overlap`) under the SPMD backend —
+    each bucket's reduce-scatter starts while earlier buckets are still
+    completing, up to the window depth in flight — and the nonblocking
+    Isend/Irecv pipeline on the eager backend.  Bit-identical to the
+    blocking form either way."""
     return comm.Allreduce_tree(tree, MPI_SUM, bucket_bytes=bucket_bytes,
-                               mean=True)
+                               mean=True, overlap=overlap)
 
 
 def dp_loss(comm, local_loss_fn, params, batch):

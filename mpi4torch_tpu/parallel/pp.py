@@ -307,7 +307,7 @@ def schedule_1f1b(rank: int, size: int, n_mb: int):
 def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
                        microbatches: List,
                        loss_fn: Callable[[Any, int], Any],
-                       recv_like=None, tag: int = 0):
+                       recv_like=None, tag: int = 0, overlap=None):
     """One training step of a 1F1B (PipeDream-flush) pipeline; returns
     ``(loss, grads)`` on every rank.
 
@@ -331,7 +331,17 @@ def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
     reference's tag+10 reverse-flow discipline,
     csrc/extension.cpp:1159-1166).  Deadlock-free because sends are
     buffered (ops/eager.py Isend: payload is deposited immediately;
-    Wait-on-send is local)."""
+    Wait-on-send is local).
+
+    ``overlap`` (None → the :func:`mpi4torch_tpu.config.overlap_scope`
+    / process default): truthy switches every stage-boundary send to
+    the split-phase form — ``Isend`` with its ``Wait`` *deferred* in a
+    double-buffered window (depth 2, or the given int), so a stage
+    posts the next microbatch's activation (or cotangent) before
+    completing the previous send's bookkeeping and the boundary stops
+    serializing on send completion.  Pure scheduling: activations and
+    cotangents are untouched data movement, so loss and grads are
+    bit-identical to the blocking-send schedule (regression-tested)."""
     rank, size = int(comm.rank), comm.size
     n_mb = len(microbatches)
     if size == 1:
@@ -341,6 +351,9 @@ def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
     if rank > 0 and recv_like is None:
         raise ValueError("ranks > 0 need recv_like (incoming activation "
                          "shape/dtype)")
+    from ..overlap import overlap_depth, resolve_overlap
+    overlap = resolve_overlap(overlap)
+    depth = overlap_depth(overlap) if overlap else 0
     fwd_tag = tag            # + i, activation of microbatch i
     bwd_tag = tag + n_mb     # + i, cotangent of microbatch i
     is_last = rank == size - 1
@@ -348,8 +361,22 @@ def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
     import collections
 
     stash = collections.deque()   # (pullback, out_aval) per in-flight mb
+    pending_sends = collections.deque()   # deferred split-phase Waits
     grads = jax.tree.map(jnp.zeros_like, params)
     total = jnp.zeros(())
+
+    def ship(x, dest, t):
+        # Blocking send, or the double-buffered split-phase form: post
+        # the Isend now (the buffered payload is already with the
+        # peer), defer its Wait until the window is full — at most
+        # `depth` un-completed sends per stage, the 1F1B analogue of
+        # keeping two bucket collectives in flight.
+        if not depth:
+            comm.Send(x, dest, t)
+            return
+        pending_sends.append(comm.Isend(x, dest, t))
+        while len(pending_sends) > depth:
+            comm.Wait(pending_sends.popleft())
 
     def fwd(i):
         nonlocal total
@@ -364,7 +391,7 @@ def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
             stash.append((pull, None))
         else:
             y, pull = jax.vjp(apply_stage, params, x)
-            comm.Send(y, rank + 1, fwd_tag + i)
+            ship(y, rank + 1, fwd_tag + i)
             stash.append((pull, jax.eval_shape(lambda: y)))
 
     def bwd(i):
@@ -378,10 +405,13 @@ def pipeline_step_1f1b(comm, apply_stage: Callable[[Any, Any], Any], params,
         dp, dx = pull(ct)
         grads = jax.tree.map(jnp.add, grads, dp)
         if rank > 0:
-            comm.Send(dx, rank - 1, bwd_tag + i)
+            ship(dx, rank - 1, bwd_tag + i)
 
     for op, i in schedule_1f1b(rank, size, n_mb):
         (fwd if op == "F" else bwd)(i)
+    while pending_sends:
+        # Drain the window: every request completes exactly once.
+        comm.Wait(pending_sends.popleft())
 
     loss = comm.Bcast_(total, size - 1)
     return loss, grads

@@ -88,7 +88,7 @@ def zero_init(comm, opt, params):
 
 
 def zero_step(comm, opt, params, local_grads, opt_state,
-              grad_transform=None):
+              grad_transform=None, overlap=None):
     """One ZeRO-1 update; returns ``(new_params, new_opt_state)``.
 
     ``local_grads`` are this rank's UN-reduced loss gradients (their sum
@@ -103,7 +103,16 @@ def zero_step(comm, opt, params, local_grads, opt_state,
     compute the TRUE norm with :func:`shard_global_norm` and scale by
     the same scalar on every rank (a shard-local
     ``optax.clip_by_global_norm`` inside ``opt`` would clip each rank
-    by its own shard norm and silently diverge from replicated DP)."""
+    by its own shard norm and silently diverge from replicated DP).
+
+    ``overlap`` (None → the :func:`mpi4torch_tpu.config.overlap_scope`
+    / process default): truthy under the SPMD backend runs both wire
+    legs through the split-phase scheduler
+    (:mod:`mpi4torch_tpu.overlap`) — the gradient reduce-scatters ride
+    a windowed start/wait pipeline, and the updated-shard all-gathers
+    take the double-buffered prefetch — bit-identical to the blocking
+    step, with the communication free to hide under the optimizer
+    compute between each bucket's start and its Wait."""
     size = comm.size
 
     # Fused bucketed reduce-scatter (mpi4torch_tpu.fuse): one collective
@@ -114,13 +123,13 @@ def zero_step(comm, opt, params, local_grads, opt_state,
     # backend, ~n_leaves/n_buckets fewer launches on both.
     from ..fuse import fused_reduce_scatter_tree
     g_shards = fused_reduce_scatter_tree(comm, local_grads, MPI_SUM,
-                                         mean=True)
+                                         mean=True, overlap=overlap)
     if grad_transform is not None:
         g_shards = grad_transform(g_shards)
     p_shards = zero3_shard_params(comm, params)
     updates, new_state = opt.update(g_shards, opt_state, p_shards)
     p_shards = jax.tree.map(jnp.add, p_shards, updates)
-    return zero3_params(comm, p_shards, params), new_state
+    return zero3_params(comm, p_shards, params, overlap=overlap), new_state
 
 
 # ---------------------------------------------------------------------------
@@ -154,7 +163,7 @@ def zero3_shard_params(comm, params):
         lambda p: _my_shard(comm, _pad_flat(p, comm.size)), params)
 
 
-def zero3_params(comm, p_shards, template):
+def zero3_params(comm, p_shards, template, overlap=None):
     """Differentiable gather: full parameters from this rank's shards.
     Inside ``jax.grad``, the adjoint reduce-scatters the parameter
     cotangents back to shards — summing over ranks on the way, so the
@@ -165,9 +174,18 @@ def zero3_params(comm, p_shards, template):
     buckets, one Allgather per bucket instead of per leaf — and the
     adjoint is the matching fused per-bucket reduce-scatter.  Always
     exact: parameter shards must not ride a scope-level gradient codec
-    (drift would accumulate across steps)."""
+    (drift would accumulate across steps).
+
+    ``overlap`` (None → the :func:`mpi4torch_tpu.config.overlap_scope`
+    / process default): truthy under the SPMD backend takes the
+    double-buffered *prefetch* (:func:`mpi4torch_tpu.overlap.
+    prefetch_allgather_tree`) — bucket ``k+1``'s all-gather is on the
+    wire before bucket ``k``'s Wait, so the gather of the next layer's
+    parameters hides under the current layer's forward; the adjoint is
+    the same window of reduce-scatters in reverse.  Bit-identical to
+    the blocking gather."""
     from ..fuse import fused_allgather_tree
-    return fused_allgather_tree(comm, p_shards, template)
+    return fused_allgather_tree(comm, p_shards, template, overlap=overlap)
 
 
 def zero3_init(comm, opt, params):

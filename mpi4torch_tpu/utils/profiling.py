@@ -28,9 +28,10 @@ import contextlib
 __all__ = ["profiler_trace", "bucket_scope"]
 
 
-def bucket_scope(op: str, index: int, total: int, codec=None):
+def bucket_scope(op: str, index: int, total: int, codec=None, phase=None):
     """Named scope for one bucket of a fused tree collective
-    (mpi4torch_tpu.fuse): ``mpi4torch.<op>.bucket<i>of<n>[.<codec>]``.
+    (mpi4torch_tpu.fuse):
+    ``mpi4torch.<op>.bucket<i>of<n>[.<codec>][.<phase>]``.
 
     The fused path replaces hundreds of per-leaf op spans with a few
     per-bucket ones; these scopes keep the profiler story intact —
@@ -38,12 +39,29 @@ def bucket_scope(op: str, index: int, total: int, codec=None):
     compressed buckets carry the codec suffix exactly like the facade's
     single-tensor ops (``mpi4torch.Allreduce.q8``).  Nested inside the
     facade's own per-op scope, so a fused q8 bucket shows as
-    ``mpi4torch.Allreduce_tree.bucket0of3.q8/mpi4torch.Allreduce.q8``."""
+    ``mpi4torch.Allreduce_tree.bucket0of3.q8/mpi4torch.Allreduce.q8``.
+
+    ``phase`` labels the split-phase halves of the overlap scheduler
+    (mpi4torch_tpu.overlap): ``"start"`` spans cover the issue of a
+    bucket's collective, ``"wait"`` spans its completion point — so a
+    trace separates *hidden* communication (device collective activity
+    that falls under compute spans issued between a bucket's ``.start``
+    and ``.wait``) from *exposed* communication (activity that the
+    timeline shows under the ``.wait`` span itself, where the program
+    had nothing else to run).  The blocking path's unsuffixed bucket
+    spans are 100% exposed by construction, which is what
+    ``bench._bench_overlap_zero`` quantifies wall-clock-side."""
     import jax
 
     name = f"mpi4torch.{op}.bucket{index}of{total}"
     if codec is not None:
         name += f".{codec.name}"
+    if phase is not None:
+        if phase not in ("start", "wait"):
+            raise ValueError(
+                f"bucket_scope phase must be 'start' or 'wait', got "
+                f"{phase!r}")
+        name += f".{phase}"
     return jax.named_scope(name)
 
 

@@ -96,6 +96,7 @@ def _isolated_tune_state(tmp_path, monkeypatch):
     mpi.config.set_phase_pipelined_ring(True)
     mpi.config.set_hier_group_size(None)
     mpi.config.set_default_algorithm(None)
+    mpi.config.set_chain_unroll_max(mpi.config.DEFAULT_CHAIN_UNROLL_MAX)
 
 
 def census(fn, *args, nr=CENSUS_NR, mesh_axes=None):
@@ -616,12 +617,13 @@ class TestSelector:
                 nranks=nr)())
             np.testing.assert_allclose(out, float(nr))
 
-    def test_bidir_scan_form_bitwise_matches_unrolled(self, monkeypatch):
-        # Past _CHAIN_UNROLL_MAX ranks each chain phase rolls into a
-        # lax.scan (O(1) program size on big pods); the wire schedule —
-        # and therefore the bits — must be identical to the unrolled
-        # census form.  Force the scan form on the 8-rank world.
-        from mpi4torch_tpu.ops import spmd as _spmd
+    def test_bidir_scan_form_bitwise_matches_unrolled(self):
+        # Past config.chain_unroll_max() ranks each chain phase rolls
+        # into a lax.scan (O(1) program size on big pods); the wire
+        # schedule — and therefore the bits — must be identical to the
+        # unrolled census form.  Force the scan form on the 8-rank
+        # world via the promoted config knob (ISSUE 5 satellite; the
+        # autouse fixture restores the default).
         rng = np.random.default_rng(31)
         data = jnp.asarray(rng.standard_normal((NR, 37)).astype(np.float32))
 
@@ -633,10 +635,22 @@ class TestSelector:
             return y, g
 
         uy, ug = mpi.run_spmd(body)(data)
-        monkeypatch.setattr(_spmd, "_CHAIN_UNROLL_MAX", 2)
+        mpi.config.set_chain_unroll_max(2)
         sy, sg = mpi.run_spmd(body)(data)
         np.testing.assert_array_equal(np.asarray(uy), np.asarray(sy))
         np.testing.assert_array_equal(np.asarray(ug), np.asarray(sg))
+
+    def test_chain_unroll_max_validated_and_fingerprinted(self):
+        # The ISSUE 3 threshold-promotion contract: validated setter +
+        # run_spmd jit-cache fingerprint coverage.
+        before = mpi.config.thresholds_fingerprint()
+        mpi.config.set_chain_unroll_max(7)
+        assert mpi.config.chain_unroll_max() == 7
+        assert mpi.config.thresholds_fingerprint() != before
+        with pytest.raises(ValueError, match="chain_unroll_max"):
+            mpi.config.set_chain_unroll_max(0)
+        with pytest.raises(ValueError, match="chain_unroll_max"):
+            mpi.config.set_chain_unroll_max("many")
 
     def test_unknown_algorithm_raises(self):
         with pytest.raises(ValueError, match="unknown collective"):
