@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -50,6 +50,17 @@ overlap-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.overlap --smoke
+
+# CPU smoke run of the in-schedule quantized pipeline
+# (mpi4torch_tpu.compress): the q8/q8_ef_hop compressed-bidir (and
+# torus) allreduce checked BITWISE against the constants.reduce_q8_hop
+# fold oracle on the 8-virtual-device mesh, the int8-permutes-on-both-
+# rotations HLO census, and the Pallas-hop-kernel-vs-jnp-fallback bit
+# equivalence in interpret mode; exits non-zero on any divergence.
+quant-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.compress --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -136,17 +136,22 @@ def _resolve_algorithm(algorithm, nranks, collective="allreduce"):
 
 def _reconcile_codec_algorithm(codec, algo, codec_explicit: bool,
                                algo_explicit: bool):
-    """Resolve a codec/algorithm pairing that does not compose
-    (``Codec.algorithms``; every shipped codec is ring-only).  Both
-    halves explicit → raise; otherwise the scope-provided half yields
-    (explicit algorithm → exact wire; explicit/scope codec → ring).
-    One shared rule for the per-tensor facade and the fused per-bucket
-    path, with one exception type."""
-    if codec is None or algo in (None, "ring"):
+    """Resolve a codec/algorithm pairing that does not compose.  The
+    composition predicate is consulted DYNAMICALLY on both sides —
+    ``Codec.algorithms`` (the codec's declared set; the block-q8 family
+    declares the ring-shaped trio ring/bidir/torus, the bf16 family is
+    ring-only) × ``AlgorithmSpec.codec_capable`` (the registry's side) —
+    via :func:`mpi4torch_tpu.compress.codec_rides_algorithm`, never a
+    hard-coded ring tuple.  Both halves explicit → raise; otherwise the
+    scope-provided half yields (explicit algorithm → exact wire;
+    explicit/scope codec → ring).  One shared rule for the per-tensor
+    facade and the fused per-bucket path, with one exception type."""
+    if codec is None or algo is None:
         return codec, algo
+    from .compress import codec_rides_algorithm
     from .tune import codec_algorithms
 
-    if algo in codec_algorithms(codec):
+    if codec_rides_algorithm(codec, algo):
         return codec, algo
     if codec_explicit and algo_explicit:
         raise ValueError(
@@ -329,21 +334,25 @@ class MPI_Communicator:
         crossover).  The backward pass uses the matching algorithm —
         ``bidir``'s backward rides the same dual-ring machinery with
         the channel directions swapped.  Codecs declare
-        which algorithms they compose with (``q8`` is ring-only): an
-        explicit algorithm + explicit codec that do not compose raise;
-        with only one of them explicit, the scope-provided half
-        degrades (explicit algorithm → exact wire; explicit codec →
-        ring)."""
+        which algorithms they compose with (the block-q8 family rides
+        ``ring``/``bidir``/``torus`` — the in-schedule quantized
+        pipeline on each ring-shaped channel — while the bf16 family is
+        ring-only): an explicit algorithm + explicit codec that do not
+        compose raise; with only one of them explicit, the
+        scope-provided half degrades (explicit algorithm → exact wire;
+        explicit codec → ring)."""
         backend, codec, algo, algo_explicit = self._allreduce_plan(
             tensor, op, compression, algorithm)
         scope = "mpi4torch.Allreduce" + (f".{codec.name}" if codec else "")
-        if codec is None and algo not in (None, "ring"):
+        if algo not in (None, "ring"):
             scope += f".{algo}"
         with jax.named_scope(scope):
             if codec is None:
                 return backend.allreduce(tensor, op, algorithm=algo,
                                          algorithm_explicit=algo_explicit)
-            return backend.allreduce_compressed(tensor, op, codec)
+            return backend.allreduce_compressed(
+                tensor, op, codec, algorithm=algo,
+                algorithm_explicit=algo_explicit)
 
     def Allreduce_tree(self, tree, op: int, compression=None,
                        bucket_bytes=None, mean: bool = False,
@@ -610,9 +619,12 @@ class _EagerBackend:
         return _eager.allreduce(self._ctx, x, op, algorithm=algorithm,
                                 algorithm_explicit=algorithm_explicit)
 
-    def allreduce_compressed(self, x, op, codec):
+    def allreduce_compressed(self, x, op, codec, algorithm=None,
+                             algorithm_explicit=False):
         from .compress import eager as _ceager
-        return _ceager.allreduce(self._ctx, x, op, codec)
+        return _ceager.allreduce(self._ctx, x, op, codec,
+                                 algorithm=algorithm,
+                                 algorithm_explicit=algorithm_explicit)
 
     def allgather_compressed(self, x, gatheraxis, codec):
         from .compress import eager as _ceager

@@ -36,9 +36,29 @@ from __future__ import annotations
 from ..config import (compression_scope, default_compression,
                       set_default_compression)
 from .codecs import (BF16Codec, BF16StochasticCodec, BlockQ8Codec, Codec,
-                     ErrorFeedbackCodec, available_codecs, get_codec,
-                     register_codec)
+                     ErrorFeedbackCodec, HopEFQ8Codec, available_codecs,
+                     get_codec, register_codec)
 from .ef import ef_allreduce, ef_init
+
+
+def codec_rides_algorithm(codec, algorithm) -> bool:
+    """THE codec/algorithm composition predicate: True when ``codec``
+    may ride wire algorithm ``algorithm``.  Consulted dynamically on
+    BOTH sides — the codec's own declaration (``Codec.algorithms``: the
+    block-q8 family declares ring/bidir/torus, the bf16 family is
+    ring-only) and the registry's (``AlgorithmSpec.codec_capable``:
+    only the ring-shaped schedules can host a per-hop requantizing
+    pipeline) — so registering a new codec or algorithm extends or
+    restricts composition without touching this gate.  One shared rule
+    for the facade reconcile (comm._reconcile_codec_algorithm), the
+    tune selector, and the fused per-bucket picker."""
+    if codec is None:
+        return False
+    from ..tune import codec_algorithms, get_algorithm
+
+    if algorithm not in codec_algorithms(codec):
+        return False
+    return get_algorithm(algorithm).codec_capable
 
 
 def codec_applicable(codec, dtype, algorithm=None) -> bool:
@@ -52,26 +72,56 @@ def codec_applicable(codec, dtype, algorithm=None) -> bool:
     collectives per dtype-homogeneous bucket (fuse/collectives.py), so
     the degrade/raise behavior cannot drift between the two paths.
 
-    The ``algorithm`` leg consults the codec's own declaration
-    (``Codec.algorithms``; ring-only for every shipped codec — the
-    quantized pipeline is a ring): the tune selector respects it when
-    auto-choosing an algorithm under an active compression scope, and
-    the fused per-bucket picker uses it to keep compressed buckets on
-    the ring while exact tail buckets take the latency algorithm."""
+    The ``algorithm`` leg is :func:`codec_rides_algorithm` — the
+    codec's declared set × the registry's ``codec_capable`` gate,
+    consulted dynamically: the tune selector respects it when
+    auto-choosing an algorithm under an active compression scope (so
+    ``auto`` can pick the compressed ``bidir`` past the bandwidth
+    crossover), and the fused per-bucket picker uses it to keep each
+    compressed bucket on an algorithm its codec declares while exact
+    tail buckets take the latency algorithm."""
     import jax.numpy as jnp
 
     if codec is None or not jnp.issubdtype(jnp.dtype(dtype),
                                            jnp.floating):
         return False
-    if algorithm is not None and algorithm != "ring":
-        from ..tune import codec_algorithms
-
-        return algorithm in codec_algorithms(codec)
+    if algorithm is not None:
+        return codec_rides_algorithm(codec, algorithm)
     return True
+
+
+def int8_rotation_census(lowered: str, nranks: int):
+    """Both-rotations census of a lowered q8 dual-ring program: returns
+    ``(seen, fwd, bwd)`` where ``seen`` is the set of
+    ``source_target_pairs`` tables appearing on int8-typed
+    ``collective_permute`` ops in ``lowered`` and ``fwd``/``bwd`` are
+    the forward/backward full-ring tables for ``nranks`` (all
+    whitespace-normalized, so ``fwd in seen and bwd in seen`` is the
+    tentpole's census criterion).  ONE matcher shared by the test census
+    matrix (tests/test_tune.py), the ``make quant-smoke`` lane
+    (compress/__main__.py), and the bench verdict (bench.py) — the
+    StableHLO pattern cannot drift between CI, the smoke lane, and the
+    persisted wire table."""
+    import re
+
+    seen = set()
+    for m in re.finditer(
+            r'stablehlo\.collective_permute.*?'
+            r'source_target_pairs\s*=\s*dense<(\[\[.*?\]\])>'
+            r'.*?:\s*\(tensor<[^>]*i8>', lowered):
+        seen.add(m.group(1).replace(" ", ""))
+    fwd = str([[i, (i + 1) % nranks]
+               for i in range(nranks)]).replace(" ", "")
+    bwd = str([[i, (i - 1) % nranks]
+               for i in range(nranks)]).replace(" ", "")
+    return seen, fwd, bwd
 
 
 __all__ = [
     "codec_applicable",
+    "codec_rides_algorithm",
+    "int8_rotation_census",
+    "HopEFQ8Codec",
     "Codec",
     "BlockQ8Codec",
     "BF16Codec",

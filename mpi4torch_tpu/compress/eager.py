@@ -50,16 +50,100 @@ def _rank_key(codec: Codec, ctx: RankContext, salt: int):
     return jax.random.fold_in(key, n)
 
 
-def allreduce(ctx: RankContext, x, op: int, codec: Codec):
+def _resolve_algorithm(nranks: int, x, codec: Codec, algorithm,
+                       explicit: bool) -> str:
+    """Concrete algorithm for a compressed eager collective — literally
+    Mode A's resolver (compress/spmd.py ``resolve_algorithm``): one
+    implementation, so auto-selected compressed traffic CANNOT drift
+    off the bitwise cross-mode contract (both modes consult the same
+    codec-aware tune selector, crossover knobs, and torus group rule;
+    the facade's ``tune.resolve_request`` has already normalized
+    ``False``/``"auto"`` to ``None`` by the time either backend runs)."""
+    from .spmd import resolve_algorithm
+
+    return resolve_algorithm(nranks, x, codec, algorithm, explicit)
+
+
+def _hop_oracle_allreduce(ctx: RankContext, x, codec: Codec, algo: str):
+    """Compressed eager Allreduce for the block-q8 codec family: the
+    ranks exchange their RAW contributions and every result comes from
+    :func:`mpi4torch_tpu.constants.reduce_q8_hop` — the bit-exact
+    simulation of the Mode A in-schedule pipeline (same chunk layout,
+    same per-hop fresh-scale requantization, same schedule-keyed noise
+    for ``q8_ef_hop``), composed over the same multipath channels and
+    error-feedback rounds.  This is what makes compressed Mode A/B
+    parity BITWISE per (algorithm × codec) rather than statistical.
+
+    Rank 0 simulates once and a second rendezvous shares the (immutable
+    jnp) result — unconditionally, not just above ``_FOLD_ONCE_MIN``:
+    the oracle walks EVERY rank's hops (O(world × hops) jitted chunk
+    sims), so even a small tensor's redundant per-rank folds cost W×
+    the whole schedule, unlike the elementwise rendezvous fold whose
+    cheap small-tensor folds stay local below the threshold.  The
+    adjoint is the same oracle on the cotangents with ``bidir``'s
+    channel directions swapped, mirroring the SPMD backward."""
+    world, rank = ctx.world, ctx.rank
+    world.check_not_consumed(rank, x)
+    base = codec.base()
+    inner = None
+    if algo == "torus" and world.size > 1:
+        # (one-rank collectives are the identity before the oracle runs,
+        # so there is no group to resolve — same carve-out as
+        # _resolve_algorithm's validation)
+        from ..tune import resolve_hier_group
+
+        inner = resolve_hier_group(world.size)
+
+    def fold(vals, reverse):
+        return C.reduce_q8_hop(
+            vals, block=base.block, algorithm=algo, inner=inner,
+            reverse=reverse, stochastic=getattr(base, "stochastic", False),
+            hop_ef=getattr(base, "hop_ef", False),
+            ef_rounds=codec.ef_rounds)
+
+    def impl(v, reverse=False):
+        _check_concrete(v)
+        if world.size == 1:
+            return jnp.asarray(v)
+        sig = ("Allreduce.q8hop", codec.name, algo, bool(reverse),
+               _shape_sig(v))
+        vals = world.exchange(rank, sig, jnp.asarray(v))
+        red = fold(vals, reverse) if rank == 0 else None
+        return world.exchange(rank, sig + ("fold",), red)[0]
+
+    @jax.custom_vjp
+    def f(v):
+        return impl(v)
+
+    def bwd(_, g):
+        return (impl(g, reverse=(algo == "bidir")),)
+
+    f.defvjp(lambda v: (impl(v), None), bwd)
+    return f(x)
+
+
+def allreduce(ctx: RankContext, x, op: int, codec: Codec,
+              algorithm=None, algorithm_explicit: bool = False):
     """Compressed eager Allreduce: encoded payloads meet at the
     rendezvous; the decoded contributions fold in ascending rank order
     (once, shared, above the fold-once threshold).  Sum-only, like the
     SPMD path; the adjoint is the same compressed collective on the
-    cotangents."""
+    cotangents.
+
+    The block-q8 codec family takes :func:`_hop_oracle_allreduce`
+    instead — the bit-exact simulation of the Mode A in-schedule
+    pipeline, on the requested ``algorithm``'s multipath channels — so
+    cross-mode parity is bitwise for those codecs.  The bf16 family
+    keeps the rendezvous-codec fold here (``bf16r``'s per-call noise
+    counter makes its parity contract statistical by design)."""
     if op != C.MPI_SUM:
         raise CommError(
             f"compressed Allreduce supports MPI_SUM only; got "
             f"{C.op_name(op)} — drop compression= for non-sum reductions")
+    algo = _resolve_algorithm(ctx.world.size, x, codec, algorithm,
+                              algorithm_explicit)
+    if getattr(codec.base(), "hop_fused", False):
+        return _hop_oracle_allreduce(ctx, x, codec, algo)
     world, rank = ctx.world, ctx.rank
     world.check_not_consumed(rank, x)
     base = codec.base()

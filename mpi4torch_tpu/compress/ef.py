@@ -20,6 +20,16 @@ The state is a plain pytree (functional, jit/scan-friendly)::
 Works on both backends: the collective inside is the facade
 ``Allreduce(..., compression=...)``, so Mode A runs it as the quantized
 ring pipeline and Mode B at the rendezvous.
+
+Interplay with the in-schedule hop codecs (``hop_fused``): ``q8``'s
+carried residual stays exact because hop 0 of the fused pipeline
+requantizes with the codec's own block layout and power-of-two scales —
+``base.roundtrip`` reproduces precisely what this rank put on the wire,
+bit for bit, even though later hops re-quantize downstream partials.
+``q8_ef_hop`` lands in the stochastic carve-out below and carries a
+zero residual: its per-hop error feedback already re-injects residuals
+*inside* the schedule, and its unbiased rounding leaves no systematic
+error for cross-step EF to recover.
 """
 
 from __future__ import annotations

@@ -29,6 +29,24 @@ folded with ``lax.axis_index``, the hop counter, and a fingerprint of the
 encoded values) so rounding noise is independent across contributions;
 correlated noise would bias the sum.  See :func:`_hop_key` for the
 traced-program limitation on identical repeated inputs.
+
+The block-q8 codec family (``Codec.hop_fused``: ``q8``, ``q8_ef``,
+``q8_ef_hop``) takes the IN-SCHEDULE pipeline instead
+(:func:`_fused_channel`): the payload stays encoded on the wire
+end-to-end, and each ring hop runs dequantize → accumulate →
+requantize-with-fresh-block-scales as ONE fused op
+(ops/quant_kernels.py — a Pallas TPU kernel with a bit-identical jnp
+fallback, dispatched by ``config.quant_hop_impl``), so block scales
+travel with their chunks and precision loss stops compounding across
+hops (EQuARX §3.2).  These codecs also ride the multipath bandwidth
+tier: ``bidir`` runs the quantized ring on each counter-rotating half
+(int8 permutes on BOTH link rotations), ``torus`` on each transposed
+grid walk (:func:`constants.multipath_ring_orders` is the shared
+channel rule).  The eager backend folds the SAME schedule through
+:func:`constants.reduce_q8_hop`, so Mode A and Mode B are BIT-identical
+per (algorithm × codec) — including the schedule-keyed stochastic
+``q8_ef_hop``, whose per-hop rounding noise is a pure function of
+(salt, hop, rank) shared between the compiled pipeline and the oracle.
 """
 
 from __future__ import annotations
@@ -40,7 +58,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import config as _config
 from .. import constants as C
+from ..ops import quant_kernels as _qk
 from ..runtime import CommError
 from .codecs import Codec
 
@@ -157,10 +177,136 @@ def _allreduce_round(ctx, x, codec: Codec, salt: int,
     return out, resid
 
 
-def _allreduce_value(ctx, x, codec: Codec):
+def _fused_channel(ctx, flat, codec: Codec, salt: int, sigma, d: int,
+                   track: bool):
+    """One in-schedule quantized ring channel on flat f32 data: block-q8
+    ring reduce-scatter whose payload (int8 blocks + per-block f32
+    scales) stays encoded on the wire end-to-end, with the
+    dequantize→accumulate→requantize of every hop fused into one kernel
+    pass (:func:`ops.quant_kernels.dequant_accum_requant` — fresh block
+    scales per hop, so error never compounds through stale scales).
+
+    ``sigma``/``d`` give the ring walk (position → rank permutation and
+    step direction — :func:`constants.multipath_ring_orders`); the final
+    hop's requant IS the wire encode, so the trailing all-gather ships
+    the already-encoded chunks and every rank decodes the same payload
+    (bit-identical results across ranks by construction).
+
+    For the stochastic ``q8_ef_hop`` codec, each hop's rounding noise
+    comes from the schedule key (salt × hop × rank) as a kernel
+    OPERAND, and the hop's quantization residual is carried on the
+    encoding rank and folded into its next in-schedule contribution
+    (per-hop error feedback at single-round wire cost).  With ``track``
+    (the ``q8_ef`` residual round), every residual this rank produced is
+    recorded at the row of the chunk it encoded instead.
+
+    Returns ``(reduced_flat, residual_flat|None)``.  Bit-for-bit
+    mirrored by :func:`constants._sim_quant_ring` — the Mode B oracle;
+    any change here must change there."""
+    n = ctx.size
+    axis = ctx.axis_name
+    idx = lax.axis_index(axis)
+    total = flat.size
+    block = codec.block
+    xcb, nb = _qk.chunk_blocks(flat, n, block)
+    if sigma is None:
+        pos = idx
+        perm = [(p, (p + d) % n) for p in range(n)]
+        sig = list(range(n))
+    else:
+        sig = list(sigma)
+        inv = [0] * n
+        for p, r in enumerate(sig):
+            inv[r] = p
+        pos = jnp.asarray(inv)[idx]
+        perm = [(sig[p], sig[(p + d) % n]) for p in range(n)]
+    stochastic = getattr(codec, "stochastic", False)
+    hop_ef = getattr(codec, "hop_ef", False)
+
+    def noise(t):
+        if not stochastic:
+            return None
+        return _qk.hop_noise(_qk.schedule_key(salt, t, idx), nb, block)
+
+    c0 = (pos - d) % n
+    mine0 = lax.dynamic_index_in_dim(xcb, c0, 0, keepdims=False)
+    q, s = _qk.requant_blocks(mine0, noise(0))
+    err = jnp.zeros_like(xcb) if track else None
+    carry = None
+    if hop_ef or track:
+        res = _qk.block_residual(mine0, q, s)
+        if hop_ef:
+            carry = res
+        if track:
+            err = lax.dynamic_update_index_in_dim(err, res, c0, 0)
+    for t in range(1, n):
+        q = lax.ppermute(q, axis, perm=perm)
+        s = lax.ppermute(s, axis, perm=perm)
+        c = (pos - d * (t + 1)) % n
+        mine = lax.dynamic_index_in_dim(xcb, c, 0, keepdims=False)
+        if hop_ef:
+            mine = mine + carry
+        q, s, res = _qk.dequant_accum_requant(
+            q, s, mine, noise=noise(t), want_resid=hop_ef or track)
+        if hop_ef:
+            carry = res
+        if track:
+            err = lax.dynamic_update_index_in_dim(err, res, c, 0)
+    gq = lax.all_gather(q, axis, axis=0, tiled=False)
+    gs = lax.all_gather(s, axis, axis=0, tiled=False)
+    pieces = [(gq[sig[c]].astype(jnp.float32)
+               * gs[sig[c]][:, None]).reshape(-1) for c in range(n)]
+    out = jnp.concatenate(pieces)[:total]
+    resid = err.reshape(-1)[:total] if track else None
+    return out, resid
+
+
+def _fused_allreduce_value(ctx, x, codec: Codec, algorithm: str,
+                           reverse: bool):
+    """Block-q8 allreduce on the in-schedule pipeline, composed over the
+    multipath channels of ``algorithm`` and the codec's error-feedback
+    rounds.  Each channel is an independent quantized ring on its
+    element range (disjoint halves at ``constants.multipath_split``);
+    ``q8_ef`` residual rounds ride the same channel as the values they
+    correct.  ``reverse`` swaps ``bidir``'s channel directions (the
+    backward pass)."""
+    base = codec.base()
+    n = ctx.size
+    shape, dtype = x.shape, x.dtype
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    total = flat.size
+    inner = None
+    if algorithm == "torus":
+        from .. import tune as _tune
+        inner = _tune.resolve_hier_group(n)
+    orders = C.multipath_ring_orders(n, algorithm, inner=inner,
+                                     reverse=reverse)
+    m = C.multipath_split(total) if len(orders) > 1 else total
+    outs = []
+    for k, (sigma, d) in enumerate(orders):
+        if k > 0 and m >= total:
+            break
+        part = flat[:m] if k == 0 else flat[m:]
+        out, resid = _fused_channel(ctx, part, base, _qk.ring_salt(0, k),
+                                    sigma, d, track=codec.ef_rounds > 1)
+        for r in range(1, codec.ef_rounds):
+            last = r == codec.ef_rounds - 1
+            more, resid = _fused_channel(ctx, resid, base,
+                                         _qk.ring_salt(r, k), sigma, d,
+                                         track=not last)
+            out = out + more
+        outs.append(out)
+    flat_out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    return flat_out.reshape(shape).astype(dtype)
+
+
+def _allreduce_value(ctx, x, codec: Codec, algorithm: str = "ring",
+                     reverse: bool = False):
     if ctx.size == 1:
         return x
     base = codec.base()
+    if getattr(base, "hop_fused", False):
+        return _fused_allreduce_value(ctx, x, codec, algorithm, reverse)
     if codec.ef_rounds <= 1:
         return _allreduce_round(ctx, x, base, salt=0)
     # In-call error feedback: round 1 tracks every quantization residual
@@ -246,25 +392,72 @@ def _bwd_scope(opname: str, codec: Codec):
     return jax.named_scope(f"mpi4torch.{opname}Backward.{codec.name}")
 
 
-def allreduce(ctx, x, op: int, codec: Codec):
+def resolve_algorithm(ctx_size: int, x, codec: Codec, algorithm,
+                      algorithm_explicit: bool) -> str:
+    """Concrete wire algorithm for a compressed collective: ``None`` =
+    codec-aware auto selection (the tune selector restricted to the
+    algorithms the codec declares — so ``auto`` picks the compressed
+    ``bidir`` at/above the measured bandwidth crossover); named requests
+    arrive pre-reconciled by the facade (``Codec.algorithms`` ×
+    ``AlgorithmSpec.codec_capable``).  ``torus`` additionally validates
+    the 2-level group rule against THIS communicator (a set
+    ``config.hier_group_size`` can void the registry's static gate):
+    explicit requests raise, scope/auto picks degrade to ``ring`` — the
+    standard rule.  Non-hop-fused codecs pin ``ring`` (their pipeline is
+    the generic encoded ring; the facade never routes them elsewhere)."""
+    if not getattr(codec.base(), "hop_fused", False):
+        return "ring"
+    algo = algorithm
+    if algo is None:
+        from .. import tune as _tune
+
+        xa = jnp.asarray(x)
+        algo = _tune.select_auto(
+            collective="allreduce",
+            nbytes=xa.size * xa.dtype.itemsize, dtype=xa.dtype,
+            nranks=ctx_size,
+            deterministic=_config.deterministic_reductions(),
+            codec=codec)
+    if algo == "torus" and ctx_size > 1:
+        from .. import tune as _tune
+
+        try:
+            _tune.resolve_hier_group(ctx_size)
+        except CommError:
+            if algorithm_explicit:
+                raise
+            algo = "ring"
+    return algo
+
+
+def allreduce(ctx, x, op: int, codec: Codec, algorithm=None,
+              algorithm_explicit: bool = False):
     """Compressed SPMD Allreduce.  Sum-only (quantized partial-sum
     accumulation has no meaning for MAX/bitwise ops — use the exact
     path); the adjoint is the same compressed collective applied to the
-    cotangents, so gradients ride the int8/bf16 wire too."""
+    cotangents, so gradients ride the int8/bf16 wire too.
+
+    ``algorithm`` picks the wire schedule among the codec's declared
+    set: the block-q8 family rides ``ring``/``bidir``/``torus`` through
+    the in-schedule pipeline (``None`` = codec-aware auto selection);
+    the backward uses the MATCHING schedule — ``bidir``'s adjoint swaps
+    the two chains' directions, like the exact multipath backward."""
     if op != C.MPI_SUM:
         raise CommError(
             f"compressed Allreduce supports MPI_SUM only; got "
             f"{C.op_name(op)} — drop compression= for non-sum reductions")
+    algo = resolve_algorithm(ctx.size, x, codec, algorithm,
+                             algorithm_explicit)
 
     @jax.custom_vjp
     def f(v):
-        return _allreduce_value(ctx, v, codec)
+        return _allreduce_value(ctx, v, codec, algo)
 
     def bwd(_, g):
         with _bwd_scope("Allreduce", codec):
-            return (_allreduce_value(ctx, g, codec),)
+            return (_allreduce_value(ctx, g, codec, algo, reverse=True),)
 
-    f.defvjp(lambda v: (_allreduce_value(ctx, v, codec), None), bwd)
+    f.defvjp(lambda v: (_allreduce_value(ctx, v, codec, algo), None), bwd)
     return f(x)
 
 

@@ -457,6 +457,33 @@ def set_chain_unroll_max(n) -> None:
         n, "chain_unroll_max", minimum=1, unit="rank count")
 
 
+# Implementation of the fused dequantize→accumulate→requantize hop of the
+# in-schedule quantized collectives (ops/quant_kernels.py, EQuARX-style):
+# "auto" runs the Pallas TPU kernel on TPU and the bit-identical jnp
+# fallback elsewhere; "jnp" forces the fallback everywhere; "pallas"
+# forces the kernel (interpreted off-TPU — the bit-equivalence test
+# surface).  Part of the run_spmd jit fingerprint: toggling retraces.
+_QUANT_HOP_IMPLS = ("auto", "jnp", "pallas")
+_quant_hop_impl = "auto"
+
+
+def quant_hop_impl() -> str:
+    """Which implementation serves the fused quantized ring hop
+    (``ops/quant_kernels.py``): ``"auto"`` (Pallas kernel on TPU, jnp
+    fallback elsewhere — both bit-identical), ``"jnp"`` (fallback
+    everywhere), or ``"pallas"`` (kernel forced; interpreted off-TPU)."""
+    return _quant_hop_impl
+
+
+def set_quant_hop_impl(impl: str) -> None:
+    global _quant_hop_impl
+    if impl not in _QUANT_HOP_IMPLS:
+        raise ValueError(
+            f"quant_hop_impl must be one of {_QUANT_HOP_IMPLS}, got "
+            f"{impl!r}")
+    _quant_hop_impl = impl
+
+
 # Intra-group size of the 2-level `hier` allreduce on a single mesh axis.
 # None = derive: the minor axis extent when the communicator was adopted
 # from a multi-axis mesh, else the divisor of nranks closest to sqrt.
@@ -487,7 +514,7 @@ def thresholds_fingerprint():
     return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
-            _hier_group_size, _chain_unroll_max)
+            _hier_group_size, _chain_unroll_max, _quant_hop_impl)
 
 
 @contextmanager

@@ -250,6 +250,168 @@ def reduce_torus(op, values, inner: int):
     return xp.concatenate([h0, h1]).reshape(shape)
 
 
+def multipath_ring_orders(n: int, algorithm, *, inner=None,
+                          reverse: bool = False):
+    """THE channel schedules of the quantized multipath collectives: a
+    tuple of ``(sigma, direction)`` ring channels, one per multipath
+    channel of ``algorithm``.  ``sigma`` maps ring *position* to rank
+    (``None`` = identity: position ``p`` is rank ``p``); ``direction``
+    is the ring step (+1/-1).  The flat payload splits at
+    :func:`multipath_split` across the channels, and each channel runs
+    the in-schedule quantized ring (compress/spmd.py) on its half.
+
+    * ``ring`` — one identity channel.
+    * ``bidir`` — two counter-rotating identity channels (each rides one
+      direction of the bidirectional link); ``reverse`` swaps the
+      directions, which is how the backward pass reuses the forward
+      machinery (the adjoint of a ring segment is the reverse ring).
+    * ``torus`` — two same-direction channels on TRANSPOSED walks of the
+      ``(outer, inner)`` rank grid: channel 0 walks ranks row-major
+      (inner-axis links), channel 1 column-major (outer-axis links), so
+      the halves stripe across the two torus axes.
+
+    One shared rule for the SPMD lowering and the eager fold oracle
+    (:func:`reduce_q8_hop`), so Mode A and Mode B can never disagree
+    about which rank touches which chunk at which hop."""
+    if algorithm in (None, "ring"):
+        return ((None, 1),)
+    if algorithm == "bidir":
+        return ((None, -1), (None, 1)) if reverse else ((None, 1),
+                                                        (None, -1))
+    if algorithm == "torus":
+        if inner is None or inner < 1 or n % inner:
+            raise ValueError(
+                f"the torus multipath schedule needs an inner group size "
+                f"dividing the rank count; got inner={inner} for {n} "
+                "ranks")
+        outer = n // inner
+        sigma = tuple((p % outer) * inner + p // outer for p in range(n))
+        return ((None, 1), (sigma, 1))
+    raise ValueError(
+        f"no multipath ring decomposition for algorithm {algorithm!r} "
+        "(the quantized in-schedule pipeline serves ring-shaped "
+        "schedules: ring, bidir, torus)")
+
+
+def _sim_quant_ring(flats, block, sigma, d, salt, stochastic, hop_ef,
+                    track):
+    """Simulate ONE in-schedule quantized ring channel over the full
+    per-rank contribution list — the hop-for-hop, bit-for-bit replica of
+    ``compress/spmd.py`` ``_fused_channel`` (same chunk layout, same
+    requant op sequence via ops/quant_kernels, same schedule-keyed
+    noise).  The hop arithmetic runs through the JITTED forms of the
+    fallback ops (quant_kernels._hop_jnp_jit & co) so it compiles
+    exactly like the traced pipeline — op-by-op eager execution would
+    round the fused multiply-adds differently by 1-2 ulp and break the
+    bitwise contract.  Returns ``(reduced_flat,
+    per_rank_residual_flats|None)``."""
+    from .ops import quant_kernels as qk
+
+    n = len(flats)
+    total = flats[0].size
+    xcbs = [qk.chunk_blocks(f, n, block)[0] for f in flats]
+    nb = xcbs[0].shape[1]
+    sig = list(sigma) if sigma is not None else list(range(n))
+
+    def noise(t, rank):
+        if not stochastic:
+            return None
+        return qk.hop_noise(qk.schedule_key(salt, t, rank), nb, block)
+
+    state = [None] * n                      # per position: (q, scale)
+    carry = [None] * n                      # per position: hop residual
+    err = ([jnp.zeros_like(xcbs[0]) for _ in range(n)]  # per RANK
+           if track else None)
+    for p in range(n):
+        r = sig[p]
+        c0 = (p - d) % n
+        mine0 = xcbs[r][c0]
+        q, s = qk._requant_blocks_jit(mine0, noise(0, r))
+        state[p] = (q, s)
+        if hop_ef or track:
+            res = qk._block_residual_jit(mine0, q, s)
+            if hop_ef:
+                carry[p] = res
+            if track:
+                err[r] = err[r].at[c0].set(res)
+    for t in range(1, n):
+        new = [None] * n
+        for p in range(n):
+            r = sig[p]
+            q, s = state[(p - d) % n]       # payload permuted one step
+            c = (p - d * (t + 1)) % n
+            mine = xcbs[r][c]
+            if hop_ef:
+                mine = mine + carry[p]
+            q2, s2, res = qk._hop_jnp_jit(
+                q, s, mine, noise(t, r), want_resid=hop_ef or track)
+            new[p] = (q2, s2)
+            if hop_ef:
+                carry[p] = res
+            if track:
+                err[r] = err[r].at[c].set(res)
+        state = new
+    pieces = [(state[c][0].astype(jnp.float32)
+               * state[c][1][:, None]).reshape(-1) for c in range(n)]
+    out = jnp.concatenate(pieces)[:total]
+    if not track:
+        return out, None
+    return out, [e.reshape(-1)[:total] for e in err]
+
+
+def reduce_q8_hop(values, *, block: int = 256, algorithm="ring",
+                  inner=None, reverse: bool = False,
+                  stochastic: bool = False, hop_ef: bool = False,
+                  ef_rounds: int = 1):
+    """The quantized fold oracle: reduce per-rank tensors through a
+    bit-exact simulation of the in-schedule quantized collective
+    (compress/spmd.py) — chunked block-q8 ring reduce-scatter with a
+    fresh-block-scale dequantize→accumulate→requantize at every hop,
+    composed over the multipath channels of ``algorithm``
+    (:func:`multipath_ring_orders`) and the codec's error-feedback
+    rounds.
+
+    This is Mode B's side of the compressed Mode A/B parity contract:
+    the eager rendezvous backend (compress/eager.py) folds with this
+    oracle for the block-q8 codec family, so its results are
+    BIT-identical to the compiled SPMD pipeline — including the
+    stochastic ``q8_ef_hop`` variant, whose rounding noise is a pure
+    function of the schedule (ops/quant_kernels.schedule_key), not of
+    call history.  ``reverse`` mirrors the backward pass's swapped
+    ``bidir`` channel directions."""
+    vals = [jnp.asarray(v) for v in values]
+    if not vals:
+        raise ValueError("reduce_q8_hop needs at least one value")
+    n = len(vals)
+    if n == 1:
+        return vals[0]
+    shape, dtype = vals[0].shape, vals[0].dtype
+    flats = [jnp.asarray(v, jnp.float32).reshape(-1) for v in vals]
+    total = flats[0].size
+    orders = multipath_ring_orders(n, algorithm, inner=inner,
+                                   reverse=reverse)
+    m = multipath_split(total) if len(orders) > 1 else total
+    from .ops import quant_kernels as qk
+
+    outs = []
+    for k, (sigma, d) in enumerate(orders):
+        if k > 0 and m >= total:
+            break
+        chan = [f[:m] if k == 0 else f[m:] for f in flats]
+        out, resids = _sim_quant_ring(chan, block, sigma, d,
+                                      qk.ring_salt(0, k), stochastic,
+                                      hop_ef, track=ef_rounds > 1)
+        for r in range(1, ef_rounds):
+            last = r == ef_rounds - 1
+            more, resids = _sim_quant_ring(resids, block, sigma, d,
+                                           qk.ring_salt(r, k), stochastic,
+                                           hop_ef, track=not last)
+            out = out + more
+        outs.append(out)
+    flat_out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    return flat_out.reshape(shape).astype(dtype)
+
+
 # Below this element count the N-1 jnp folds beat the host round-trip of
 # the native kernel.  Measured (bench_tradeoffs.py native_reduce_crossover,
 # 8 f32 buffers, round-5 single-core host): native/jnp seconds were

@@ -250,7 +250,11 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     tail bucket below the measured latency crossover takes the
     latency-optimal schedule (``rhd``/``tree``) instead of paying
     O(nranks) ring steps for a few KiB.  Compressed buckets stay on the
-    algorithms their codec declares (``q8`` → ring)."""
+    algorithms their codec declares — for the block-q8 family that
+    includes the bandwidth tier, so a compressed body bucket past the
+    crossover rides the quantized ``bidir`` dual ring (in-schedule
+    requantizing hops on both link rotations) and the two biggest wire
+    wins compose instead of excluding each other."""
     if mean and op != C.MPI_SUM:
         raise CommError(
             f"mean=True is the rank-mean of an MPI_SUM reduction; got "

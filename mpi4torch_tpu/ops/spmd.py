@@ -1730,9 +1730,12 @@ class SpmdBackend:
         return allreduce(self._ctx, x, op, algorithm,
                          algorithm_explicit=algorithm_explicit)
 
-    def allreduce_compressed(self, x, op, codec):
+    def allreduce_compressed(self, x, op, codec, algorithm=None,
+                             algorithm_explicit=False):
         from ..compress import spmd as _cspmd
-        return _cspmd.allreduce(self._ctx, x, op, codec)
+        return _cspmd.allreduce(self._ctx, x, op, codec,
+                                algorithm=algorithm,
+                                algorithm_explicit=algorithm_explicit)
 
     def allgather_compressed(self, x, gatheraxis, codec):
         from ..compress import spmd as _cspmd

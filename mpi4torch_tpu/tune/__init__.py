@@ -24,7 +24,8 @@ decides:
 Degrade/raise rule (mirrors the compression scope's): a *scope or
 process default* that cannot legally serve a call — ``rhd`` on a
 non-power-of-two world, ``hier`` on a prime world, any non-ring
-algorithm under a wire codec that declares itself ring-only (``q8``) —
+algorithm under a wire codec that does not declare it (``bf16`` off the
+ring, any codec on the butterfly/tree/hier schedules) —
 silently falls back to auto selection (``ring`` unless measured
 evidence says otherwise, and for ``Bcast_``/``Reduce_`` the normal
 size dispatch); an *explicit per-call* ``algorithm=`` raises with the
@@ -151,8 +152,10 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     power-of-two worlds, else ``tree``); at or above the measured
     bandwidth crossover the multipath bandwidth tier wins (``bidir``,
     the dual-ring — applicable on any world); otherwise ``ring``.  A
-    codec restricts candidates to the algorithms it declares (``q8`` is
-    ring-only)."""
+    codec restricts candidates to the algorithms it declares × the
+    registry's ``codec_capable`` gate (the block-q8 family rides
+    ring/bidir/torus, the bf16 family is ring-only) and reads measured
+    winners from the cache's codec-keyed dimension."""
     if nranks <= 1 or deterministic:
         return "ring"
 
@@ -177,7 +180,11 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
 
         return codec_applicable(codec, dtype, algorithm=name)
 
-    winner = lookup_algorithm(collective, dtype, nbytes, nranks)
+    # The cache key grows a codec dimension: compressed traffic reads
+    # its own measured winners (autotune_allreduce(codecs=...)) and can
+    # never hijack — or be hijacked by — exact selection.
+    winner = lookup_algorithm(collective, dtype, nbytes, nranks,
+                              codec=codec)
     if winner is not None and ok(winner):
         return winner
     crossover = _config.latency_crossover_bytes()
@@ -189,7 +196,8 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     bandwidth = _config.bandwidth_crossover_bytes()
     if bandwidth is not None and nbytes >= bandwidth:
         # The third tier: multipath at/above the measured crossover.
-        # `bidir` is the any-world pick; `torus` wins only through a
+        # `bidir` is the any-world pick (for compressed traffic too —
+        # the block-q8 family declares it); `torus` wins only through a
         # measured cache entry (its grouping quality is topology-bound).
         if ok("bidir"):
             return "bidir"

@@ -68,14 +68,31 @@ def _platform() -> str:
     return jax.devices()[0].platform
 
 
+def _codec_name(codec) -> Optional[str]:
+    """Normalize a codec dimension value (Codec object, name string, or
+    None) to a cache-key token."""
+    if codec is None:
+        return None
+    return getattr(codec, "name", codec)
+
+
 def make_key(collective: str, dtype, nbytes: int, nranks: int,
-             platform: Optional[str] = None) -> str:
+             platform: Optional[str] = None, codec=None) -> str:
     import numpy as np
 
     if platform is None:
         platform = _platform()
-    return "|".join([collective, str(np.dtype(dtype)),
-                     str(_bucket(nbytes)), str(int(nranks)), platform])
+    key = "|".join([collective, str(np.dtype(dtype)),
+                    str(_bucket(nbytes)), str(int(nranks)), platform])
+    # The codec dimension: compressed traffic gets its OWN winner keys
+    # (a q8 bucket's crossover differs from fp32's — ~4x fewer wire
+    # bytes per element), and exact traffic keeps the codec-less keys it
+    # always had, so compressed measurements can never hijack exact
+    # selection (or vice versa).
+    name = _codec_name(codec)
+    if name is not None:
+        key += "|codec=" + str(name)
+    return key
 
 
 def _load() -> None:
@@ -185,12 +202,13 @@ def _save() -> None:
 
 
 def lookup(collective: str, dtype, nbytes: int, nranks: int,
-           platform: Optional[str] = None) -> Optional[dict]:
+           platform: Optional[str] = None, codec=None) -> Optional[dict]:
     """The cached entry for this key, or None.  Entries naming an
     algorithm the registry no longer knows (stale cache across
     versions) are ignored."""
     _load()
-    ent = _mem.get(make_key(collective, dtype, nbytes, nranks, platform))
+    ent = _mem.get(make_key(collective, dtype, nbytes, nranks, platform,
+                            codec=codec))
     if ent is None:
         return None
     try:
@@ -201,33 +219,38 @@ def lookup(collective: str, dtype, nbytes: int, nranks: int,
 
 
 def lookup_algorithm(collective: str, dtype, nbytes: int, nranks: int,
-                     platform: Optional[str] = None) -> Optional[str]:
-    ent = lookup(collective, dtype, nbytes, nranks, platform)
+                     platform: Optional[str] = None,
+                     codec=None) -> Optional[str]:
+    ent = lookup(collective, dtype, nbytes, nranks, platform, codec=codec)
     return None if ent is None else ent["algorithm"]
 
 
 def entry_from_disk(collective: str, dtype, nbytes: int, nranks: int,
-                    platform: Optional[str] = None) -> bool:
+                    platform: Optional[str] = None, codec=None) -> bool:
     """True when this key's entry was loaded from the persisted file
     (rather than measured in this process) — the bench's
     ``tuned_from_cache`` evidence."""
     _load()
     return make_key(collective, dtype, nbytes, nranks,
-                    platform) in _from_disk
+                    platform, codec=codec) in _from_disk
 
 
 def record(collective: str, dtype, nbytes: int, nranks: int,
            algorithm: str, platform: Optional[str] = None,
            measurements: Optional[dict] = None,
-           persist: bool = True) -> str:
+           persist: bool = True, codec=None) -> str:
     """Store a winner for a key (and persist).  Bumps the selection
     generation so ``run_spmd`` jit cache keys see the change and
     retrace instead of reusing a lowering picked under the old table."""
     global _generation
     _load()
     get_algorithm(algorithm)  # validate
-    key = make_key(collective, dtype, nbytes, nranks, platform)
+    key = make_key(collective, dtype, nbytes, nranks, platform,
+                   codec=codec)
     ent = {"algorithm": algorithm, "measured_at": time.time()}
+    name = _codec_name(codec)
+    if name is not None:
+        ent["codec"] = str(name)
     if measurements:
         ent["measurements"] = measurements
     _mem[key] = ent
@@ -303,7 +326,8 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
                        nranks: Optional[int] = None,
                        dtype=None, iters: int = 5,
                        persist: bool = True,
-                       apply_crossover: bool = True) -> dict:
+                       apply_crossover: bool = True,
+                       codecs: Sequence = (None,)) -> dict:
     """Benchmark every applicable allreduce algorithm at each payload
     size, record the winners in the cache, and (by default) set
     :func:`config.set_latency_crossover_bytes` AND
@@ -311,6 +335,15 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
     crossovers so three-tier auto-selection (latency algorithms below,
     ring in the middle, multipath ``bidir``/``torus`` above) reflects
     the measurement.
+
+    ``codecs`` is the sweep's codec dimension: each non-``None`` entry
+    (a codec name like ``"q8"``) re-runs the per-algorithm sweep with
+    that compression, restricted to the algorithms the codec declares
+    (compress.codec_applicable), and records winners under the cache's
+    codec-keyed dimension — so auto selection can pick the compressed
+    ``bidir`` at/above the bandwidth crossover without the compressed
+    measurements hijacking exact traffic's winners.  The crossover
+    derivation reads only the exact (``None``) sweep.
 
     Returns the report dict (also the bench's JSON stanza):
     per-size per-algorithm seconds and GB/s, the winner table, the
@@ -341,21 +374,26 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
         "entries": {},
     }
 
-    def step_fn(algorithm):
+    def step_fn(algorithm, compression):
         def body(x):
-            return comm.Allreduce(x, mpi.MPI_SUM, algorithm=algorithm)
+            return comm.Allreduce(x, mpi.MPI_SUM, algorithm=algorithm,
+                                  compression=compression or False)
 
         return mpi.run_spmd(body, nranks=n)
 
-    for nbytes in sizes:
-        nelem = max(1, int(nbytes) // itemsize)
-        x = jnp.ones((nelem,), dtype)
-        wire = 2.0 * (n - 1) / n * nelem * itemsize if n > 1 \
-            else float(nelem * itemsize)
+    def sweep_one(nbytes, x, wire, codec):
+        from ..compress import codec_applicable, get_codec
+
+        if codec is None:
+            names = _candidates(n)
+        else:
+            cobj = get_codec(codec)
+            names = [a for a in _candidates(n)
+                     if codec_applicable(cobj, dtype, algorithm=a)]
         per = {}
-        for name in _candidates(n):
+        for name in names:
             try:
-                dt = _time_step(step_fn(name), x, iters)
+                dt = _time_step(step_fn(name, codec), x, iters)
             except Exception as e:  # noqa: BLE001 — sweep must finish
                 per[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
                 continue
@@ -364,14 +402,13 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
         timed = {k: v for k, v in per.items()
                  if "seconds_per_step" in v}
         if not timed:
-            report["entries"][str(int(nbytes))] = {"algorithms": per}
-            continue
+            return {"algorithms": per}
         winner = min(timed, key=lambda k: timed[k]["seconds_per_step"])
         record("allreduce", dtype, int(nbytes), n, winner,
                platform=platform, measurements={
                    k: v["seconds_per_step"] for k, v in timed.items()},
-               persist=persist)
-        report["entries"][str(int(nbytes))] = {
+               persist=persist, codec=codec)
+        return {
             "algorithms": per,
             "winner": winner,
             "winner_latency_optimal":
@@ -379,6 +416,20 @@ def autotune_allreduce(sizes: Optional[Sequence[int]] = None,
             "winner_bandwidth_optimal":
                 get_algorithm(winner).bandwidth_optimal,
         }
+
+    for nbytes in sizes:
+        nelem = max(1, int(nbytes) // itemsize)
+        x = jnp.ones((nelem,), dtype)
+        wire = 2.0 * (n - 1) / n * nelem * itemsize if n > 1 \
+            else float(nelem * itemsize)
+        ent = sweep_one(nbytes, x, wire, None) \
+            if None in tuple(codecs) else {"algorithms": {}}
+        for codec in codecs:
+            if codec is None:
+                continue
+            ent.setdefault("codecs", {})[str(_codec_name(codec))] = \
+                sweep_one(nbytes, x, wire, codec)
+        report["entries"][str(int(nbytes))] = ent
 
     crossover = _crossover_from(report["entries"])
     report["crossover_bytes"] = crossover

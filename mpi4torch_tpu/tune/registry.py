@@ -73,6 +73,13 @@ class AlgorithmSpec:
     # above the measured config.bandwidth_crossover_bytes, and the
     # autotuner derives that crossover from the sizes they win.
     bandwidth_optimal: bool = False
+    # The registry's side of the codec/algorithm composition predicate
+    # (compress.codec_rides_algorithm): True for the ring-shaped
+    # schedules, whose channels can host the in-schedule per-hop
+    # requantizing pipeline (compress/spmd.py).  A codec additionally
+    # has to declare the algorithm in Codec.algorithms — both sides
+    # must agree before compressed traffic rides this schedule.
+    codec_capable: bool = False
     requires_power_of_two: bool = False
     requires_factorable: bool = False
     description: str = ""
@@ -166,6 +173,7 @@ def get_algorithm(spec) -> AlgorithmSpec:
 
 register_algorithm(AlgorithmSpec(
     name="ring",
+    codec_capable=True,
     collectives=("allreduce", "reduce", "bcast"),
     description="XLA-native bandwidth-optimal ring (lax.psum / masked "
                 "psum); ~2(N-1) pipelined hops, 2·S·(N-1)/N wire",
@@ -198,6 +206,7 @@ register_algorithm(AlgorithmSpec(
 ))
 register_algorithm(AlgorithmSpec(
     name="bidir",
+    codec_capable=True,
     collectives=("allreduce",),
     bandwidth_optimal=True,
     description="bidirectional dual-ring allreduce: the payload halves "
@@ -207,6 +216,7 @@ register_algorithm(AlgorithmSpec(
 ))
 register_algorithm(AlgorithmSpec(
     name="torus",
+    codec_capable=True,
     collectives=("allreduce",),
     bandwidth_optimal=True,
     requires_factorable=True,

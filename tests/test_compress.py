@@ -49,7 +49,11 @@ class TestCodecs:
         with pytest.raises(TypeError):
             get_codec(42)
 
-    @pytest.mark.parametrize("name,bound", [("q8", 1e-2), ("bf16", 5e-3),
+    # q8's power-of-two block scales (block floating point — the price
+    # of exact-by-construction dequantize arithmetic, see
+    # ops/quant_kernels.po2_scale) widen the quantization step by up to
+    # 2x vs the classic absmax/127 scale, hence the 1.6e-2 bound.
+    @pytest.mark.parametrize("name,bound", [("q8", 1.6e-2), ("bf16", 5e-3),
                                             ("bf16r", 1e-2)])
     def test_roundtrip_relative_error_bound(self, name, bound):
         codec = get_codec(name)
@@ -60,15 +64,23 @@ class TestCodecs:
         assert rel <= bound, f"{name}: {rel}"
 
     def test_q8_per_block_error_bound(self):
-        # Block-scaled contract: per-element error ≤ half an int8 step of
-        # the block's absmax.
+        # Block-floating-point contract: the scale is the smallest power
+        # of two with 127*scale >= absmax (exact products, exact
+        # division — ops/quant_kernels.po2_scale), so per-element error
+        # is <= half that scale, which is at most one int8 step of the
+        # block's absmax.
         codec = get_codec("q8")
         x = jnp.asarray(_data(1, 2048, seed=1)[0])
-        rt = np.asarray(codec.roundtrip(x), np.float32)
-        blocks = np.asarray(x).reshape(-1, codec.block)
-        step = np.abs(blocks).max(axis=1) / 127.0
+        payload, meta = codec.encode(x)
+        scale = np.asarray(payload["scale"], np.float64)
+        amax = np.abs(np.asarray(x)).reshape(-1, codec.block).max(axis=1)
+        # the scale IS a power of two in (amax/127, 2*amax/127]
+        assert (np.log2(scale) == np.round(np.log2(scale))).all()
+        assert (127.0 * scale >= amax).all()
+        assert (scale <= 2.0 * amax / 127.0 + 1e-12).all()
+        rt = np.asarray(codec.decode(payload, meta), np.float32)
         err = np.abs(np.asarray(x) - rt).reshape(-1, codec.block)
-        assert (err <= 0.5 * step[:, None] + 1e-7).all()
+        assert (err <= 0.5 * scale[:, None] + 1e-7).all()
 
     @pytest.mark.parametrize("name", ["q8", "bf16", "bf16r", "q8_ef"])
     @pytest.mark.parametrize("shape", [(), (1,), (257,), (3, 5), (2, 3, 7)])
@@ -134,7 +146,12 @@ class TestEagerCompressed:
         for r in range(1, nranks):
             np.testing.assert_array_equal(res[r], res[0])
         rel = np.linalg.norm(res[0] - exact) / np.linalg.norm(exact)
-        assert rel <= 1e-2
+        # Mode B now folds through the quantized hop oracle
+        # (constants.reduce_q8_hop) — BIT-identical to the Mode A
+        # in-schedule pipeline, so it inherits that pipeline's per-hop
+        # error compounding (~sqrt(2n) of one codec step) in exchange
+        # for bitwise cross-mode parity.
+        assert rel <= 2.5e-2
 
     def test_allreduce_grad(self, nranks):
         # AD transparency: the backward is a compressed Allreduce of the
@@ -339,7 +356,7 @@ class TestSpmdCompressed:
         assert not np.array_equal(exact, compressed)
         np.testing.assert_array_equal(after, exact)
         assert np.linalg.norm(compressed - 4 * data) \
-            <= 1e-2 * np.linalg.norm(4 * data)
+            <= 2.5e-2 * np.linalg.norm(4 * data)
 
 
 # =========================================================================
@@ -359,7 +376,7 @@ class TestModeParity:
                                              mpi.MPI_SUM,
                                              compression=codec))
 
-        eager = run_ranks(eager_body, n)[0].astype(np.float64)
+        eager = run_ranks(eager_body, n)[0]
 
         stacked = jnp.asarray(data)
 
@@ -368,17 +385,24 @@ class TestModeParity:
                                              0, keepdims=False)
             return comm.Allreduce(t, mpi.MPI_SUM, compression=codec)
 
-        spmd = np.asarray(mpi.run_spmd(spmd_fn, nranks=n)(stacked))[0] \
-            .astype(np.float64)
+        spmd = np.asarray(mpi.run_spmd(spmd_fn, nranks=n)(stacked))[0]
 
         norm = np.linalg.norm(exact)
-        assert np.linalg.norm(eager - exact) <= 1e-2 * norm
-        # Mode A's ring re-encodes partials per hop (~sqrt(2n) of one
-        # codec step for single-round codecs; q8_ef cancels it), so
-        # parity is within combined codec error, not bit equality.
-        spmd_bound = 1e-3 if codec == "q8_ef" else 2e-2
-        assert np.linalg.norm(spmd - exact) <= spmd_bound * norm
-        assert np.linalg.norm(spmd - eager) <= 3e-2 * norm
+        # The block-q8 family holds BITWISE cross-mode parity: Mode B
+        # folds through constants.reduce_q8_hop, the bit-exact replica
+        # of Mode A's in-schedule hop pipeline.  bf16 keeps the
+        # rendezvous-codec fold (statistical parity — its pipeline
+        # re-encodes per hop only in Mode A).
+        if codec in ("q8", "q8_ef"):
+            np.testing.assert_array_equal(spmd, eager)
+        else:
+            assert np.linalg.norm(spmd.astype(np.float64)
+                                  - eager.astype(np.float64)) <= 3e-2 * norm
+        spmd_bound = 1e-3 if codec == "q8_ef" else 2.5e-2
+        assert np.linalg.norm(spmd.astype(np.float64) - exact) \
+            <= spmd_bound * norm
+        assert np.linalg.norm(eager.astype(np.float64) - exact) \
+            <= 2.5e-2 * norm
 
 
 # =========================================================================
@@ -471,7 +495,9 @@ class TestConfigSemantics:
         finally:
             mpi.config.set_default_compression(None)
         err = np.linalg.norm(res[0] - exact)
-        assert 0 < err <= 1e-2 * np.linalg.norm(exact)  # lossy => engaged
+        # 2.5e-2: the Mode B hop oracle compounds per-hop error like the
+        # Mode A pipeline (bitwise parity contract).
+        assert 0 < err <= 2.5e-2 * np.linalg.norm(exact)  # lossy => engaged
 
     def test_scope_none_overrides_process_default(self):
         data = _data(2, seed=21)
@@ -554,7 +580,7 @@ class TestConfigSemantics:
         res = run_ranks(body, 2)
         exact = data.sum(0)
         err = np.linalg.norm(res[0] - exact)
-        assert 0 < err <= 1e-2 * np.linalg.norm(exact)
+        assert 0 < err <= 2.5e-2 * np.linalg.norm(exact)
 
     def test_bf16r_fresh_noise_per_call_eager(self):
         # The eager backend folds a per-rank call counter into the key:
@@ -658,3 +684,292 @@ class TestConfigSemantics:
         y1, r1, y2, r2 = run_ranks(body, 2)[0]
         np.testing.assert_array_equal(y1, y2)
         np.testing.assert_array_equal(r1, r2)
+
+
+# =========================================================================
+# In-schedule quantization on the multipath tier (ISSUE 6)
+# =========================================================================
+
+
+def _hop_codec_pairs():
+    """Every (codec-capable algorithm × block-q8 codec) pair the
+    registries compose — computed from the LIVE registries, so a new
+    registration extends this matrix automatically (the registry-sync
+    guard in test_tune.py asserts the enumeration rules)."""
+    from mpi4torch_tpu import tune
+
+    pairs = []
+    for algo in tune.available_algorithms():
+        if not tune.get_algorithm(algo).codec_capable:
+            continue
+        for name in available_codecs():
+            codec = get_codec(name)
+            if algo not in codec.algorithms:
+                continue
+            base = codec.base()
+            if not getattr(base, "hop_fused", False):
+                continue
+            pairs.append((algo, name))
+    return pairs
+
+
+class TestInScheduleMultipath:
+    """The tentpole contract: the block-q8 family rides ring/bidir/torus
+    through the fused in-schedule pipeline, Mode A and Mode B are
+    BIT-identical per (algorithm × codec) — values and gradients, every
+    world shape the acceptance criteria name — and the eager oracle
+    (constants.reduce_q8_hop) is the single source of Mode B's fold."""
+
+    # (1,), (3,), (8,) flat worlds plus the (2,4) torus factorization
+    # of 8 (config.hier_group_size pins inner=4 → grid (outer=2,
+    # inner=4)).
+    WORLDS = [(1, None), (3, None), (8, None), (8, 4)]
+
+    @pytest.mark.parametrize("algo,codec", _hop_codec_pairs())
+    @pytest.mark.parametrize("world,group", WORLDS)
+    def test_mode_a_b_bitwise_values_and_grads(self, algo, codec, world,
+                                               group):
+        from mpi4torch_tpu.runtime import CommError
+
+        if algo == "torus" and world == 3:
+            pytest.skip("torus needs a factorable world")
+        if group is not None and algo != "torus":
+            # config.hier_group_size only enters the torus channel
+            # striping — for ring/bidir the group-pinned world runs the
+            # exact same computation as the unpinned (8,) cell above.
+            pytest.skip("group pin is torus-only; cell duplicates "
+                        "the unpinned world")
+        data = _data(world, m=700, seed=31)
+        stacked = jnp.asarray(data)
+
+        def run(a=algo, c=codec):
+            def spmd_fn(x):
+                t = jax.lax.dynamic_index_in_dim(
+                    x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+                y, g = jax.value_and_grad(lambda v: jnp.vdot(
+                    comm.Allreduce(v, mpi.MPI_SUM, compression=c,
+                                   algorithm=a), v))(t)
+                return y, g
+
+            ya, ga = mpi.run_spmd(spmd_fn, nranks=world)(stacked)
+
+            def eager_body():
+                t = jnp.asarray(data[comm.rank])
+                y, g = jax.value_and_grad(lambda v: jnp.vdot(
+                    comm.Allreduce(v, mpi.MPI_SUM, compression=c,
+                                   algorithm=a), v))(t)
+                return np.asarray(y), np.asarray(g)
+
+            eb = run_ranks(eager_body, world)
+            return np.asarray(ya), np.asarray(ga), eb
+
+        if group is None:
+            ya, ga, eb = run()
+        else:
+            mpi.config.set_hier_group_size(group)
+            try:
+                ya, ga, eb = run()
+            finally:
+                mpi.config.set_hier_group_size(None)
+        for r in range(world):
+            np.testing.assert_array_equal(ya[r], eb[r][0],
+                                          err_msg=f"value rank {r}")
+            np.testing.assert_array_equal(ga[r], eb[r][1],
+                                          err_msg=f"grad rank {r}")
+
+    @pytest.mark.parametrize("algo,codec", _hop_codec_pairs())
+    def test_deterministic_mode_bitwise(self, algo, codec):
+        # The acceptance criterion's "including deterministic_mode"
+        # leg: the compressed pipeline is deterministic by construction
+        # (fixed associations, schedule-keyed noise), so the parity
+        # contract holds under the flag too.
+        data = _data(4, m=500, seed=37)
+        stacked = jnp.asarray(data)
+
+        def spmd_fn(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            return comm.Allreduce(t, mpi.MPI_SUM, compression=codec,
+                                  algorithm=algo)
+
+        with mpi.config.deterministic_mode(True):
+            a_out = np.asarray(mpi.run_spmd(spmd_fn, nranks=4)(stacked))
+        b_out = run_ranks(
+            lambda: np.asarray(comm.Allreduce(
+                jnp.asarray(data[comm.rank]), mpi.MPI_SUM,
+                compression=codec, algorithm=algo)), 4)
+        for r in range(4):
+            np.testing.assert_array_equal(a_out[r], b_out[r])
+
+    def test_oracle_is_the_mode_b_fold(self):
+        # constants.reduce_q8_hop called directly reproduces the eager
+        # backend's result — the oracle IS the fold, not a lookalike.
+        from mpi4torch_tpu import constants as C
+
+        data = _data(4, seed=41)
+        want = np.asarray(C.reduce_q8_hop(
+            [jnp.asarray(d) for d in data], block=256, algorithm="bidir"))
+        got = run_ranks(
+            lambda: np.asarray(comm.Allreduce(
+                jnp.asarray(data[comm.rank]), mpi.MPI_SUM,
+                compression="q8", algorithm="bidir")), 4)[0]
+        np.testing.assert_array_equal(want, got)
+
+    def test_values_close_to_exact_on_multipath(self):
+        data = _data(NR, seed=43)
+        exact = data.sum(0)
+        stacked = jnp.asarray(data)
+        for algo in ("bidir", "torus"):
+            def spmd_fn(x, a=algo):
+                t = jax.lax.dynamic_index_in_dim(
+                    x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+                return comm.Allreduce(t, mpi.MPI_SUM, compression="q8",
+                                      algorithm=a)
+
+            out = np.asarray(mpi.run_spmd(spmd_fn, nranks=NR)(stacked))
+            for r in range(1, NR):
+                np.testing.assert_array_equal(out[r], out[0])
+            rel = np.linalg.norm(out[0] - exact) / np.linalg.norm(exact)
+            assert rel <= 2.5e-2, f"{algo}: {rel}"
+
+    def test_explicit_bidir_q8_composes_and_tree_q8_raises(self):
+        # The lifted pin: explicit (bidir, q8) now composes; an
+        # explicitly incompatible pair still raises via the shared
+        # reconcile path.
+        data = jnp.ones((NR, 64), jnp.float32)
+
+        def ok(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            return comm.Allreduce(t, mpi.MPI_SUM, compression="q8",
+                                  algorithm="bidir")
+
+        out = np.asarray(mpi.run_spmd(ok, nranks=NR)(data))
+        np.testing.assert_array_equal(out[0], np.full(64, float(NR)))
+
+        def bad(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            return comm.Allreduce(t, mpi.MPI_SUM, compression="q8",
+                                  algorithm="tree")
+
+        with pytest.raises(ValueError, match="cannot carry this codec"):
+            mpi.run_spmd(bad, nranks=NR)(data)
+
+    def test_scope_codec_with_explicit_tree_degrades_codec(self):
+        # One-explicit-half degrade: scope codec yields to the explicit
+        # non-composing algorithm (exact wire), mirroring the facade's
+        # standard rule — no fork from _reconcile_codec_algorithm.
+        data = jnp.ones((NR, 32), jnp.float32)
+
+        def fn(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            with mpi.config.compression_scope("q8"):
+                return comm.Allreduce(t, mpi.MPI_SUM, algorithm="tree")
+
+        out = np.asarray(mpi.run_spmd(fn, nranks=NR)(data))
+        np.testing.assert_array_equal(out[0], np.full(32, float(NR)))
+
+    def test_explicit_torus_q8_on_prime_world_raises(self):
+        data = jnp.ones((5, 16), jnp.float32)
+
+        def fn(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+            return comm.Allreduce(t, mpi.MPI_SUM, compression="q8",
+                                  algorithm="torus")
+
+        with pytest.raises(mpi.CommError, match="factorization"):
+            mpi.run_spmd(fn, nranks=5)(data)
+
+    def test_auto_selection_picks_compressed_bidir_past_crossover(self):
+        # The composed win: under an active compression scope, auto
+        # algorithm selection reaches the bandwidth tier for the
+        # compressed payload (codec-aware select_auto) — the two wire
+        # wins multiply.
+        from mpi4torch_tpu import tune
+
+        data = _data(4, m=1 << 16, seed=47)  # 256 KiB of f32
+        stacked = jnp.asarray(data)
+        mpi.config.set_bandwidth_crossover_bytes(1 << 16)
+        try:
+            def fn(x):
+                t = jax.lax.dynamic_index_in_dim(
+                    x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+                return comm.Allreduce(t, mpi.MPI_SUM, compression="q8")
+
+            auto = np.asarray(mpi.run_spmd(fn, nranks=4)(stacked))
+
+            def pinned(x):
+                t = jax.lax.dynamic_index_in_dim(
+                    x, jnp.asarray(comm.rank + 0), 0, keepdims=False)
+                return comm.Allreduce(t, mpi.MPI_SUM, compression="q8",
+                                      algorithm="bidir")
+
+            want = np.asarray(mpi.run_spmd(pinned, nranks=4)(stacked))
+            # Mode B resolves auto through the SAME codec-aware selector
+            # (compress/eager._resolve_algorithm) — auto-selected
+            # compressed traffic keeps the bitwise cross-mode contract,
+            # not just explicitly-pinned algorithms.
+            eager_auto = run_ranks(
+                lambda: np.asarray(comm.Allreduce(
+                    jnp.asarray(data[comm.rank]), mpi.MPI_SUM,
+                    compression="q8")), 4)
+        finally:
+            mpi.config.set_bandwidth_crossover_bytes(None)
+        np.testing.assert_array_equal(auto, want)
+        for r in range(4):
+            np.testing.assert_array_equal(eager_auto[r], auto[r])
+
+
+class TestPerHopErrorFeedback:
+    """q8_ef_hop: stochastic per-hop rounding + per-hop error feedback
+    at single-round wire cost."""
+
+    def test_wire_cost_is_single_round(self):
+        codec = get_codec("q8_ef_hop")
+        assert codec.ef_rounds == 1
+        fp32 = (1 << 16) * 4
+        assert fp32 / codec.wire_bytes((1 << 16,), jnp.float32) >= 3.5
+
+    def test_unbiased_over_repeated_salts(self):
+        # Stochastic rounding is unbiased: averaging the oracle's output
+        # over many schedule salts converges to the exact sum, where
+        # round-to-nearest q8 keeps a fixed deterministic bias.
+        from mpi4torch_tpu import constants as C
+
+        data = _data(4, m=512, seed=53)
+        exact = data.sum(0).astype(np.float64)
+        vals = [jnp.asarray(d) for d in data]
+        acc = np.zeros(512, np.float64)
+        trials = 24
+        for salt in range(trials):
+            out = C._sim_quant_ring(
+                [jnp.asarray(v, jnp.float32) for v in vals], 256, None, 1,
+                1000 + salt, True, True, False)[0]
+            acc += np.asarray(out, np.float64)
+        stoch_bias = np.abs(acc / trials - exact).mean()
+        det = np.asarray(C.reduce_q8_hop(vals, block=256), np.float64)
+        det_bias = np.abs(det - exact).mean()
+        assert stoch_bias < det_bias
+
+    @pytest.mark.slow
+    def test_convergence_no_worse_than_one_shot_q8_ef(self):
+        # The acceptance regression: the per-hop EF loss trajectory ends
+        # no worse than the two-round q8_ef codec's (which pays 2x the
+        # wire), and both land within 2% of fp32 — at HALF q8_ef's wire
+        # cost for the hop variant.  (`slow`: two 150-step DP trainings;
+        # runs in `make test` and the TPU-manual lane — the tier-1
+        # budget keeps only the bitwise/census contracts.)
+        fp32 = _fp32_loss()
+        ef_hop = _dp_train(2, compression="q8_ef_hop")[0]
+        ef = _dp_train(2, compression="q8_ef")[0]
+        assert abs(ef_hop - fp32) <= max(abs(ef - fp32), 0.02 * fp32)
+
+    @pytest.mark.slow
+    def test_hop_ef_beats_plain_q8_on_training(self):
+        fp32 = _fp32_loss()
+        ef_hop = _dp_train(2, compression="q8_ef_hop")[0]
+        q8 = _dp_train(2, compression="q8")[0]
+        assert abs(ef_hop - fp32) <= abs(q8 - fp32) + 0.01 * fp32

@@ -1,0 +1,202 @@
+"""Fused dequant→accumulate→requant hop kernel tests (ops/quant_kernels).
+
+The acceptance surface of the in-schedule quantization tentpole (ISSUE 6):
+the Pallas TPU kernel and the pure-jnp fallback are BIT-equal in
+interpret mode (every operand combination — deterministic and
+stochastic rounding, with and without the residual output), the
+power-of-two block-scale arithmetic is exact by construction, the shared
+chunk/salt/key helpers are pure functions of the schedule, and the
+``config.quant_hop_impl`` knob validates and participates in the
+``run_spmd`` jit fingerprint so toggling it retraces.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu.ops import quant_kernels as qk
+
+RNG = np.random.default_rng(17)
+
+
+def _blocks(rows=300, block=256, scale=3.0):
+    q = jnp.asarray(RNG.integers(-127, 128, (rows, block)), jnp.int8)
+    s = qk.po2_scale(jnp.abs(jnp.asarray(
+        RNG.standard_normal(rows), jnp.float32)) * 0.1 + 1e-3)
+    m = jnp.asarray(RNG.standard_normal((rows, block)).astype(np.float32)
+                    * scale)
+    noise = jnp.asarray(RNG.random((rows, block), np.float32))
+    return q, s, m, noise
+
+
+class TestPo2Scale:
+    def test_scale_is_power_of_two_and_brackets_amax(self):
+        amax = jnp.abs(jnp.asarray(
+            RNG.standard_normal(4096), jnp.float32)) * 100.0
+        s = np.asarray(qk.po2_scale(amax), np.float64)
+        a = np.asarray(amax, np.float64)
+        assert (np.log2(s) == np.round(np.log2(s))).all()
+        assert (127.0 * s >= a).all()
+        nz = a > 127 * 2.0 ** -126
+        assert (s[nz] <= 2.0 * a[nz] / 127.0).all()
+
+    def test_zero_and_tiny_amax_clamp_to_smallest_normal(self):
+        s = np.asarray(qk.po2_scale(jnp.asarray([0.0, 1e-40], jnp.float32)))
+        assert (s == np.float32(2.0 ** -126)).all()
+
+    def test_dequant_products_are_exact(self):
+        # The whole point of the power-of-two scale: q * scale never
+        # rounds, so FMA contraction cannot perturb the pipeline.
+        q, s, _, _ = _blocks()
+        prod32 = np.asarray(q, np.float32) * np.asarray(s)[:, None]
+        prod64 = np.asarray(q, np.float64) * np.asarray(s, np.float64)[:, None]
+        assert (prod32.astype(np.float64) == prod64).all()
+
+    def test_requant_blocks_matches_codec_encode(self):
+        # The hop_fused contract: requant_blocks on block-shaped data IS
+        # BlockQ8Codec.encode, bit for bit.
+        from mpi4torch_tpu.compress import get_codec
+
+        codec = get_codec("q8")
+        x = jnp.asarray(RNG.standard_normal((8, codec.block)), jnp.float32)
+        q, s = qk.requant_blocks(x)
+        payload, _ = codec.encode(x.reshape(-1))
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(payload["q"]))
+        np.testing.assert_array_equal(np.asarray(s),
+                                      np.asarray(payload["scale"]))
+
+    def test_integer_blocks_roundtrip_exactly(self):
+        x = jnp.asarray(RNG.integers(-60, 61, (4, 256)), jnp.float32)
+        q, s = qk.requant_blocks(x)
+        np.testing.assert_array_equal(
+            np.asarray(q, np.float32) * np.asarray(s)[:, None],
+            np.asarray(x))
+
+
+class TestKernelVsFallback:
+    @pytest.mark.parametrize("want_resid", [False, True])
+    @pytest.mark.parametrize("stochastic", [False, True])
+    def test_bit_equal_in_interpret_mode(self, want_resid, stochastic):
+        # impl="pallas" off-TPU runs the kernel interpreted — the
+        # equivalence surface the acceptance criteria name.
+        q, s, m, noise = _blocks()
+        nz = noise if stochastic else None
+        a = qk.dequant_accum_requant(q, s, m, noise=nz,
+                                     want_resid=want_resid, impl="jnp")
+        b = qk.dequant_accum_requant(q, s, m, noise=nz,
+                                     want_resid=want_resid, impl="pallas")
+        for x, y in zip(a, b):
+            if x is None:
+                assert y is None
+            else:
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_row_padding_is_inert(self):
+        # 300 rows does not divide the 256-row tile: the kernel pads,
+        # computes, slices — and non-tile row counts must not leak
+        # padded rows into the outputs (shape + bit checks).
+        q, s, m, _ = _blocks(rows=300)
+        q2, s2, resid = qk.dequant_accum_requant(q, s, m, want_resid=True,
+                                                 impl="pallas")
+        assert q2.shape == (300, 256) and s2.shape == (300,)
+        assert resid.shape == (300, 256)
+
+    def test_non_tileable_block_takes_fallback(self):
+        # Lane axis must tile to 128 for the kernel; other block sizes
+        # fall back to jnp even under impl="pallas".
+        assert not qk.hop_available(100)
+        q = jnp.zeros((4, 100), jnp.int8)
+        s = jnp.ones((4,), jnp.float32)
+        m = jnp.ones((4, 100), jnp.float32)
+        out = qk.dequant_accum_requant(q, s, m, impl="pallas")
+        np.testing.assert_array_equal(
+            np.asarray(out[0], np.float32) * np.asarray(out[1])[:, None],
+            np.ones((4, 100), np.float32))
+
+    def test_fused_hop_equals_decode_add_encode(self):
+        # The fusion is an op-sequence identity, not an approximation:
+        # one kernel pass == decode -> add -> encode through the codec.
+        from mpi4torch_tpu.compress import get_codec
+
+        codec = get_codec("q8")
+        q, s, m, _ = _blocks(rows=8)
+        q2, s2, _ = qk.dequant_accum_requant(q, s, m, impl="jnp")
+        part = m + q.astype(jnp.float32) * s[:, None]
+        payload, _ = codec.encode(part.reshape(-1))
+        np.testing.assert_array_equal(np.asarray(q2),
+                                      np.asarray(payload["q"]))
+        np.testing.assert_array_equal(np.asarray(s2),
+                                      np.asarray(payload["scale"]))
+
+
+class TestScheduleHelpers:
+    def test_schedule_key_is_pure_function_of_salt_hop_rank(self):
+        a = qk.schedule_key(3, 2, 5)
+        b = qk.schedule_key(3, 2, 5)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for other in (qk.schedule_key(4, 2, 5), qk.schedule_key(3, 1, 5),
+                      qk.schedule_key(3, 2, 6)):
+            assert not np.array_equal(np.asarray(a), np.asarray(other))
+
+    def test_schedule_key_traced_rank_matches_python_rank(self):
+        # The Mode A pipeline folds a traced lax.axis_index rank; the
+        # eager oracle a Python int — same bits, or cross-mode parity
+        # of q8_ef_hop would silently break.
+        want = np.asarray(qk.hop_noise(qk.schedule_key(1, 2, 3), 4, 256))
+        got = np.asarray(jax.jit(
+            lambda r: qk.hop_noise(qk.schedule_key(1, 2, r), 4, 256))(3))
+        np.testing.assert_array_equal(want, got)
+
+    def test_chunk_blocks_layout(self):
+        flat = jnp.arange(1000, dtype=jnp.float32)
+        xcb, nb = qk.chunk_blocks(flat, 4, 256)
+        assert xcb.shape == (4, nb, 256) and nb == 1
+        np.testing.assert_array_equal(
+            np.asarray(xcb).reshape(-1)[:1000], np.asarray(flat))
+        assert (np.asarray(xcb).reshape(-1)[1000:] == 0).all()
+
+    def test_ring_salt_distinct_per_round_and_channel(self):
+        salts = {qk.ring_salt(r, k) for r in range(3) for k in range(2)}
+        assert len(salts) == 6
+
+
+class TestConfigKnob:
+    def test_validates(self):
+        with pytest.raises(ValueError, match="quant_hop_impl"):
+            mpi.config.set_quant_hop_impl("nope")
+        assert mpi.config.quant_hop_impl() == "auto"
+
+    def test_knob_is_in_thresholds_fingerprint(self):
+        # Part of the run_spmd jit cache key: toggling retraces instead
+        # of silently reusing the other implementation's lowering.
+        base = mpi.config.thresholds_fingerprint()
+        mpi.config.set_quant_hop_impl("jnp")
+        try:
+            assert mpi.config.thresholds_fingerprint() != base
+        finally:
+            mpi.config.set_quant_hop_impl("auto")
+        assert mpi.config.thresholds_fingerprint() == base
+
+    def test_forced_impls_agree_end_to_end(self):
+        # The full compressed allreduce under each forced implementation
+        # produces identical bits (the interpret-mode kernel path runs
+        # the real Pallas kernel body).
+        data = jnp.asarray(RNG.standard_normal((4, 600)), jnp.float32)
+
+        def fn(x):
+            t = jax.lax.dynamic_index_in_dim(
+                x, jnp.asarray(mpi.COMM_WORLD.rank + 0), 0, keepdims=False)
+            return mpi.COMM_WORLD.Allreduce(t, mpi.MPI_SUM,
+                                            compression="q8")
+
+        outs = {}
+        for impl in ("jnp", "pallas"):
+            mpi.config.set_quant_hop_impl(impl)
+            try:
+                outs[impl] = np.asarray(
+                    mpi.run_spmd(fn, nranks=4)(data))
+            finally:
+                mpi.config.set_quant_hop_impl("auto")
+        np.testing.assert_array_equal(outs["jnp"], outs["pallas"])

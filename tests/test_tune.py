@@ -57,18 +57,52 @@ ALGOS = ("ring", "rhd", "tree", "hier", "bidir", "torus")
 # registry, so registering an algorithm without census coverage fails
 # here rather than shipping untested.
 CENSUS_COVERED = frozenset(ALGOS)
+# The codec-capable side of the registry (AlgorithmSpec.codec_capable):
+# the ring-shaped schedules whose channels host the in-schedule
+# quantized pipeline.  The guard asserts this literal equals the
+# registry AND that every registered codec declares only names from it,
+# so the (algorithm × codec) census matrix below — computed from the
+# live registries — provably enumerates every combination a wire can
+# carry.  Same structural pattern as SPLIT_PHASE_FORMS in
+# test_nonblocking.py.
+CODEC_CAPABLE = ("ring", "bidir", "torus")
 COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
                "collective_permute")
 
 comm = mpi.COMM_WORLD
 
 
+def _codec_algorithm_pairs():
+    """Every (codec-capable algorithm × codec declaring it) pair, from
+    the LIVE registries — parametrizes the per-pair census test, so a
+    newly registered codec or codec-capable algorithm gets census
+    coverage automatically (and the guard below fails if the
+    enumeration rules themselves drift)."""
+    from mpi4torch_tpu.compress import available_codecs, get_codec
+
+    pairs = []
+    for algo in tune.available_algorithms():
+        if not tune.get_algorithm(algo).codec_capable:
+            continue
+        for name in available_codecs():
+            if algo in get_codec(name).algorithms:
+                pairs.append((algo, name))
+    return pairs
+
+
 def test_registry_sync_guard():
     """Every registered AlgorithmSpec name must be exercised by the
     parity/grads matrix (ALGOS — parametrizes TestAlgorithmParity and
     TestBitwiseDeterministicParity) AND the HLO census matrix
-    (CENSUS_COVERED).  A future algorithm registered in tune/registry.py
-    without extending these matrices fails CI right here."""
+    (CENSUS_COVERED); the codec-capable subset must match
+    CODEC_CAPABLE, and every registered codec must declare only
+    codec-capable algorithms — which makes the computed
+    (algorithm × codec) matrix (_codec_algorithm_pairs, parametrizing
+    TestCodecAlgorithmCensus) a complete enumeration.  A future
+    algorithm or codec registered without census coverage fails CI
+    right here."""
+    from mpi4torch_tpu.compress import available_codecs, get_codec
+
     registered = set(tune.available_algorithms())
     assert registered == set(ALGOS), (
         f"registered algorithms {sorted(registered)} out of sync with "
@@ -79,6 +113,23 @@ def test_registry_sync_guard():
         f"the HLO census matrix {sorted(CENSUS_COVERED)} — add a "
         "forward+backward census test and list the name in "
         "CENSUS_COVERED")
+    capable = {a for a in registered if tune.get_algorithm(a).codec_capable}
+    assert capable == set(CODEC_CAPABLE), (
+        f"codec-capable algorithms {sorted(capable)} out of sync with "
+        f"CODEC_CAPABLE {sorted(CODEC_CAPABLE)} — extend the literal "
+        "(and check TestCodecAlgorithmCensus covers the new schedule)")
+    for name in available_codecs():
+        declared = set(get_codec(name).algorithms)
+        assert declared <= capable, (
+            f"codec {name!r} declares algorithms {sorted(declared)} "
+            "outside the registry's codec_capable set — either mark the "
+            "algorithm codec_capable (and census the pair) or fix the "
+            "codec's declaration")
+        assert declared, f"codec {name!r} declares no algorithms — " \
+            "even exact-wire fallbacks need 'ring'"
+    pairs = _codec_algorithm_pairs()
+    assert pairs and len(pairs) == len(set(pairs))
+    assert ("bidir", "q8") in pairs and ("torus", "q8_ef_hop") in pairs
 
 
 @pytest.fixture(autouse=True)
@@ -576,9 +627,17 @@ class TestSelector:
         # deterministic mode pins the bit-exact ring fold
         assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
                                 nranks=NR, deterministic=True) == "ring"
-        # a ring-only codec keeps large compressed payloads on the ring
+        # the block-q8 family declares the bandwidth tier: past the
+        # crossover, compressed traffic composes with the dual ring (the
+        # in-schedule quantized pipeline on both rotations) — the two
+        # biggest wire wins multiply instead of excluding each other
         assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
-                                nranks=NR, codec=get_codec("q8")) == "ring"
+                                nranks=NR, codec=get_codec("q8")) == "bidir"
+        # a ring-only codec (bf16: generic encoded-ring pipeline) still
+        # keeps large compressed payloads on the ring
+        assert tune.select_auto(nbytes=4 << 20, dtype=jnp.float32,
+                                nranks=NR,
+                                codec=get_codec("bf16")) == "ring"
 
     def test_cached_multipath_winner_wins(self):
         tune.record("allreduce", jnp.float32, 8 << 20, NR, "torus")
@@ -1286,3 +1345,103 @@ class TestConfigKnobs:
         finally:
             mpi.config.set_ordered_fold_gather_max_bytes(saved[0])
             mpi.config.set_ordered_ring_chunk_bytes(saved[1])
+
+
+# ---------------------------------------------------------------------------
+# (algorithm × codec) census: every pair the registries compose, guarded
+# ---------------------------------------------------------------------------
+
+
+class TestCodecAlgorithmCensus:
+    """One forward HLO census per (codec-capable algorithm × codec)
+    pair — parametrized from the LIVE registries
+    (_codec_algorithm_pairs), so an unguarded combination cannot exist:
+    registering one makes a census test appear, and the registry-sync
+    guard pins the enumeration rules.  The expected collective counts
+    are STRUCTURAL: per error-feedback round and per multipath channel,
+    a quantized ring is (n-1) permute hops of the payload leaves plus
+    one encoded all-gather of each leaf."""
+
+    # big enough that both multipath halves are non-empty and span
+    # multiple q8 blocks per chunk
+    X = jnp.ones((4096,), jnp.float32)
+
+    @pytest.mark.parametrize("algo,codec", _codec_algorithm_pairs())
+    def test_pair_census(self, algo, codec):
+        from mpi4torch_tpu.compress import get_codec
+
+        cobj = get_codec(codec)
+        leaves = len(jax.tree_util.tree_leaves(
+            cobj.base().encode(jnp.ones(64, jnp.float32))[0]))
+        channels = 2 if algo in ("bidir", "torus") else 1
+        rounds = cobj.ef_rounds
+        got, txt = census(
+            lambda c, x: c.Allreduce(x, mpi.MPI_SUM, compression=codec,
+                                     algorithm=algo), self.X)
+        n = CENSUS_NR
+        assert got["all_reduce"] == 0, (algo, codec, got)
+        assert got["collective_permute"] == \
+            rounds * channels * (n - 1) * leaves, (algo, codec, got)
+        assert got["all_gather"] == rounds * channels * leaves, \
+            (algo, codec, got)
+        if cobj.base().hop_fused:
+            # the quantized payload rides int8 end-to-end
+            assert re.search(r"collective_permute.*xi8>", txt)
+            assert re.search(r"all_gather.*xi8>", txt)
+
+    @pytest.mark.parametrize("codec", ["q8", "q8_ef_hop"])
+    def test_bidir_int8_permutes_on_both_rotations(self, codec):
+        # The tentpole's census criterion: int8 collective_permutes on
+        # BOTH source_target_pairs rotations of the dual ring.
+        from mpi4torch_tpu.compress import int8_rotation_census
+
+        _, txt = census(
+            lambda c, x: c.Allreduce(x, mpi.MPI_SUM, compression=codec,
+                                     algorithm="bidir"), self.X)
+        norm, fwd, bwd = int8_rotation_census(txt, CENSUS_NR)
+        assert fwd in norm and bwd in norm, (
+            f"int8 permutes must ride both rotations; saw {sorted(norm)}")
+
+    def test_bidir_fwd_bwd_census_doubles_with_swapped_rotations(self):
+        # AD transparency on the multipath wire: the backward is the
+        # same dual-ring schedule with channel directions swapped, so
+        # the fwd+bwd program has exactly 2x the quantized collectives.
+        got, txt = census(
+            lambda c, x: jax.value_and_grad(lambda v: jnp.vdot(
+                c.Allreduce(v, mpi.MPI_SUM, compression="q8",
+                            algorithm="bidir"), v))(x), self.X)
+        n = CENSUS_NR
+        assert got["collective_permute"] == 2 * 2 * 2 * (n - 1)
+        assert got["all_gather"] == 2 * 2 * 2
+        assert got["all_reduce"] == 0
+
+    def test_codec_keyed_cache_dimension(self):
+        # The tune cache's codec dimension: compressed winners live
+        # under their own keys and cannot hijack exact traffic.
+        key_exact = tune.make_key("allreduce", jnp.float32, 1 << 20, NR,
+                                  platform="cpu")
+        key_q8 = tune.make_key("allreduce", jnp.float32, 1 << 20, NR,
+                               platform="cpu", codec="q8")
+        assert key_exact != key_q8 and key_q8.endswith("codec=q8")
+        tune.record("allreduce", jnp.float32, 1 << 20, NR, "torus",
+                    codec="q8")
+        from mpi4torch_tpu.compress import get_codec
+        assert tune.select_auto(nbytes=1 << 20, dtype=jnp.float32,
+                                nranks=NR, codec=get_codec("q8")) == "torus"
+        # exact traffic is untouched by the compressed winner
+        assert tune.select_auto(nbytes=1 << 20, dtype=jnp.float32,
+                                nranks=NR) == "ring"
+
+    def test_autotune_sweep_codec_dimension(self):
+        # The sweep's codec leg records winners under codec keys and
+        # restricts candidates to what the codec declares.
+        report = tune.autotune_allreduce(
+            sizes=(1 << 12,), nranks=4, iters=1, persist=False,
+            codecs=(None, "q8"))
+        ent = report["entries"][str(1 << 12)]
+        assert "winner" in ent                      # exact sweep intact
+        q8_ent = ent["codecs"]["q8"]
+        assert set(q8_ent["algorithms"]) <= set(CODEC_CAPABLE)
+        assert "winner" in q8_ent
+        assert tune.lookup_algorithm("allreduce", jnp.float32, 1 << 12, 4,
+                                     codec="q8") == q8_ent["winner"]
