@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -61,6 +61,20 @@ quant-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.compress --smoke
+
+# CPU smoke run of the fault matrix (mpi4torch_tpu.resilience): every
+# registered fault kind — rank death, delay, dropped p2p message,
+# NaN/Inf corruption, wire bit-flip, truncated checkpoint save —
+# injected into one representative collective per subsystem (plain /
+# fused / compressed / overlap, plus the checkpoint recovery cell) on
+# the (3,), (8,) and (2,4)-torus worlds.  Exits non-zero if ANY fault
+# goes undetected, unattributed, or silently corrupts a result, or if
+# the fault-kind registry and the matrix coverage table drift apart
+# (the registry-sync guard).
+faults-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.resilience --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -525,6 +525,97 @@ def _bench_allreduce_compressed_multipath(on_tpu: bool):
     return out
 
 
+def _bench_guard_overhead(on_tpu: bool):
+    """Integrity-guard overhead census (mpi4torch_tpu.resilience,
+    ISSUE 7): a DETERMINISTIC HLO proof that the guards are free when
+    off and a priced, censused addition when on.
+
+    * ``comm_finite_guard="off"`` (default) and checksum-off lowerings
+      are BIT-IDENTICAL to the pre-guard program — checked structurally
+      by re-lowering the same facade call with the guard hook
+      monkeypatched out entirely (the guard-less build) and comparing
+      the full StableHLO text, not just op counts;
+    * guard-on ("warn") records the per-collective op deltas: one
+      ``is_finite`` + reduce feeding one host callback ``custom_call``;
+    * ``comm_wire_checksum`` is a Mode B (rendezvous wire) leg only —
+      toggling it must leave the Mode A lowering untouched, and that
+      claim is censused here too."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu._compat import shard_map
+    from mpi4torch_tpu.resilience import guards as _rguards
+
+    n = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("w",))
+    cm = mpi.comm_from_mesh(mesh, "w")
+    x = jnp.ones((1 << 14,), jnp.float32)
+
+    def lowered(compression=False):
+        return jax.jit(shard_map(
+            lambda a: cm.Allreduce(a, mpi.MPI_SUM,
+                                   compression=compression),
+            mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False)).lower(x).as_text()
+
+    def counts(text):
+        return {"is_finite": text.count("stablehlo.is_finite"),
+                "custom_call": text.count("stablehlo.custom_call")}
+
+    out = {"n_devices": n, "modes": {}}
+    # Guard off (the default): must match the guard-LESS build bit for
+    # bit.  The bypass monkeypatch removes the hook structurally, so the
+    # comparison is against a program in which the guard code never ran.
+    mpi.config.set_comm_finite_guard("off")
+    mpi.config.set_comm_wire_checksum(False)
+    text_off = lowered()
+    text_off_q8 = lowered("q8")
+    hook = _rguards.spmd_finite_value
+    try:
+        _rguards.spmd_finite_value = lambda v, where: v
+        text_bypassed = lowered()
+        text_bypassed_q8 = lowered("q8")
+    finally:
+        _rguards.spmd_finite_value = hook
+    out["guard_off_identical_to_guardless_build"] = (
+        text_off == text_bypassed and text_off_q8 == text_bypassed_q8)
+    out["modes"]["off"] = counts(text_off)
+
+    # Checksum on: a Mode B wire leg — the Mode A lowering must not move.
+    mpi.config.set_comm_wire_checksum(True)
+    try:
+        out["checksum_on_lowering_identical"] = lowered() == text_off
+    finally:
+        mpi.config.set_comm_wire_checksum(False)
+
+    # Guard on: the priced deltas.
+    mpi.config.set_comm_finite_guard("warn")
+    try:
+        text_on = lowered()
+        text_on_q8 = lowered("q8")
+    finally:
+        mpi.config.set_comm_finite_guard("off")
+    out["modes"]["warn"] = counts(text_on)
+    out["guard_on_op_delta"] = {
+        k: counts(text_on)[k] - counts(text_off)[k]
+        for k in ("is_finite", "custom_call")}
+    out["guard_on_op_delta_q8"] = {
+        k: counts(text_on_q8)[k] - counts(text_off_q8)[k]
+        for k in ("is_finite", "custom_call")}
+    out["zero_overhead_off_path"] = bool(
+        out["guard_off_identical_to_guardless_build"]
+        and out["checksum_on_lowering_identical"]
+        and out["modes"]["off"]["is_finite"] == 0)
+    out["note"] = ("deterministic lowering census — identical on CPU "
+                   "smoke and hardware; wall-clock guard cost is the "
+                   "is_finite reduce + host callback and only exists "
+                   "when the guard is on")
+    return out
+
+
 def _bench_allreduce_fused(on_tpu: bool):
     """Fused bucketed vs per-leaf Allreduce on a real DP ResNet gradient
     tree (mpi4torch_tpu.fuse, ISSUE 2): collective-launch counts read off
@@ -1414,6 +1505,7 @@ def main() -> None:
         ara = _guarded("allreduce_algorithms", _bench_allreduce_algorithms,
                        on_tpu)
         ovz = _guarded("overlap_zero", _bench_overlap_zero, on_tpu)
+        gov = _guarded("guard_overhead", _bench_guard_overhead, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -1447,6 +1539,7 @@ def main() -> None:
             "allreduce_fused": arf,
             "allreduce_algorithms": ara,
             "overlap_zero": ovz,
+            "guard_overhead": gov,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

@@ -25,7 +25,7 @@ from pathlib import Path
 DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism",
-         "compression", "fusion", "algorithms", "overlap",
+         "compression", "fusion", "algorithms", "overlap", "resilience",
          "api_reference", "design_tpu", "glossary"]
 
 CSS = """

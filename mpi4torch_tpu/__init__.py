@@ -46,7 +46,10 @@ from .runtime import (
     CollectiveMismatchError,
     CommError,
     DeadlockError,
+    HealthReport,
     InPlaceReuseError,
+    IntegrityError,
+    RankFailedError,
     run_ranks,
 )
 from .mesh import device_mesh, hybrid_mesh
@@ -64,9 +67,11 @@ from . import compress
 from . import fuse
 from . import tune
 from . import overlap
+from . import resilience
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
+from .resilience import FaultPlan, FaultSpec, fault_scope
 
 __all__ = [
     # reference __all__ (src/__init__.py:5-25)
@@ -109,7 +114,11 @@ __all__ = [
     "fuse",
     "tune",
     "overlap",
+    "resilience",
     "SpmdWaitHandle",
+    "FaultPlan",
+    "FaultSpec",
+    "fault_scope",
     "algorithm_scope",
     "compression_scope",
     "fusion_scope",
@@ -119,6 +128,9 @@ __all__ = [
     "DeadlockError",
     "InPlaceReuseError",
     "BifurcationError",
+    "RankFailedError",
+    "IntegrityError",
+    "HealthReport",
 ]
 
 __version__ = "0.1.0"

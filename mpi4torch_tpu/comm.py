@@ -26,8 +26,8 @@ import numpy as _np
 
 from . import constants as C
 from .ops import eager as _eager
-from .runtime import (CommError, RankContext, current_rank_context,
-                      effective_rank_context)
+from .runtime import (CommError, HealthReport, RankContext,
+                      current_rank_context, effective_rank_context)
 
 
 class WaitHandle:
@@ -250,6 +250,34 @@ class MPI_Communicator:
         """Number of processes in the communicator (reference:
         src/__init__.py:113-116)."""
         return self._backend().size
+
+    # --------------------------------------------------------------- health
+
+    def check_health(self, timeout=None) -> HealthReport:
+        """Timeout-bounded ATTRIBUTED barrier probe
+        (mpi4torch_tpu.resilience): every live rank calls it
+        collectively; the report says whether all ranks answered within
+        ``timeout`` (default: the world's deadlock timeout) and, when
+        not, WHICH ranks arrived and which are missing/dead — the
+        question a preempted or hung job needs answered before deciding
+        to checkpoint-restore or rebuild the world.  Unlike a regular
+        Barrier, a failed probe *returns* its attributed report (no
+        retries, no typed raise) and leaves the collective rendezvous
+        state untouched.
+
+        Host-level by nature: available on the eager thread world
+        (``run_ranks``) and the size-1 default world; inside a compiled
+        SPMD program there is no host to probe from, so it raises
+        :class:`CommError` there."""
+        backend = self._backend()
+        probe = getattr(backend, "check_health", None)
+        if probe is None:
+            raise CommError(
+                "check_health is a host-level liveness probe: call it on "
+                "the eager thread world (run_ranks) or outside SPMD "
+                "regions — a compiled SPMD program cannot host-probe "
+                "mid-schedule")
+        return probe(timeout)
 
     # ----------------------------------------------------------- collectives
 
@@ -614,6 +642,9 @@ class _EagerBackend:
     @property
     def size(self) -> int:
         return self._ctx.world.size
+
+    def check_health(self, timeout=None) -> HealthReport:
+        return self._ctx.world.health_check(self._ctx.rank, timeout)
 
     def allreduce(self, x, op, algorithm=None, algorithm_explicit=False):
         return _eager.allreduce(self._ctx, x, op, algorithm=algorithm,

@@ -30,11 +30,33 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import config as _config
 from .. import constants as C
+from ..resilience import guards as _guards
 from ..runtime import CommError, RankContext
 from ..ops.eager import _FOLD_ONCE_MIN, _check_concrete, _norm_axis, \
     _shape_sig
 from .codecs import Codec
+
+
+def _wire_exchange(world, rank: int, sig, meta, payload, opname: str):
+    """Ship an encoded wire tuple through the rendezvous, with the
+    optional checksum leg (``config.comm_wire_checksum``): each rank's
+    payload travels with the CRC of its wire bytes and every rank
+    verifies the full list on receipt — a corrupted block (e.g. an
+    injected bit-flip on the int8 wire) raises
+    :class:`~mpi4torch_tpu.IntegrityError` NAMING the corrupt
+    contributor instead of folding silently into everyone's result.
+    Off (default): the wire tuple and signature are exactly the
+    pre-checksum format.  Returns the rank-ordered ``(meta, payload)``
+    list."""
+    if _config.comm_wire_checksum():
+        # The CRC covers meta AND payload: a corrupted block scale in
+        # the meta mis-steers the decode exactly like a flipped block.
+        item = (meta, payload, _guards.wire_checksum((meta, payload)))
+        vals = world.exchange(rank, sig + ("crc",), item)
+        return _guards.verify_wire(vals, opname)
+    return world.exchange(rank, sig, (meta, payload))
 
 
 def _rank_key(codec: Codec, ctx: RankContext, salt: int):
@@ -108,6 +130,9 @@ def _hop_oracle_allreduce(ctx: RankContext, x, codec: Codec, algo: str):
         sig = ("Allreduce.q8hop", codec.name, algo, bool(reverse),
                _shape_sig(v))
         vals = world.exchange(rank, sig, jnp.asarray(v))
+        # Finite guard on the raw contributions (every rank holds the
+        # same list — symmetric raise) before the hop oracle folds them.
+        _guards.check_contributions(vals, f"Allreduce[{codec.name}]")
         red = fold(vals, reverse) if rank == 0 else None
         return world.exchange(rank, sig + ("fold",), red)[0]
 
@@ -152,23 +177,32 @@ def allreduce(ctx: RankContext, x, op: int, codec: Codec,
         """Returns (cross-rank sum of decoded payloads, own roundtrip)."""
         payload, meta = base.encode(v, _rank_key(base, ctx, salt))
         sig = ("Allreduce.c", codec.name, salt, _shape_sig(v))
-        vals = world.exchange(rank, sig, (meta, payload))
+        vals = _wire_exchange(world, rank, sig, meta, payload,
+                              f"Allreduce[{codec.name}]")
         if jnp.asarray(v).size >= _FOLD_ONCE_MIN:
             # Fold-once: rank 0 decodes + folds all payloads, the result
             # (an immutable jnp array) is shared through a second
             # rendezvous; every other rank decodes only its own payload
             # (needed for the EF residual) — W-1 redundant W-way
             # decode+folds saved, mirroring ops/eager.py's exact path.
+            # The finite guard runs on rank 0's full decode (the only
+            # rank holding it); its typed IntegrityError becomes the
+            # job's primary error through the world-failure path.
             own_m, own_p = vals[rank]
             own = base.decode(own_p, own_m)
-            red = (C.reduce_ordered(
-                C.MPI_SUM, [base.decode(p, m) for (m, p) in vals])
-                if rank == 0 else None)
+            if rank == 0:
+                decoded_all = [base.decode(p, m) for (m, p) in vals]
+                _guards.check_contributions(decoded_all,
+                                            f"Allreduce[{codec.name}]")
+                red = C.reduce_ordered(C.MPI_SUM, decoded_all)
+            else:
+                red = None
             out = world.exchange(
                 rank, ("Allreduce.c.fold", codec.name, salt, _shape_sig(v)),
                 red)[0]
             return out, own
         decoded = [base.decode(p, m) for (m, p) in vals]
+        _guards.check_contributions(decoded, f"Allreduce[{codec.name}]")
         return C.reduce_ordered(C.MPI_SUM, decoded), decoded[rank]
 
     def impl(v):
@@ -214,8 +248,10 @@ def allgather(ctx: RankContext, x, gatheraxis: int, codec: Codec):
         othershape = tuple(s for i, s in enumerate(v.shape) if i != ax)
         sig = ("Allgather.c", codec.name, salt, ax, othershape,
                str(jnp.asarray(v).dtype))
-        vals = world.exchange(rank, sig, (meta, payload))
+        vals = _wire_exchange(world, rank, sig, meta, payload,
+                              f"Allgather[{codec.name}]")
         decoded = [base.decode(p, m) for (m, p) in vals]
+        _guards.check_contributions(decoded, f"Allgather[{codec.name}]")
         return decoded
 
     def impl(v):
@@ -235,7 +271,8 @@ def allgather(ctx: RankContext, x, gatheraxis: int, codec: Codec):
     def bwd_round(g, counts, salt: int):
         payload, meta = base.encode(g, _rank_key(base, ctx, salt))
         sig = ("Allgather.c.bwd", codec.name, salt, ax, _shape_sig(g))
-        vals = world.exchange(rank, sig, (meta, payload))
+        vals = _wire_exchange(world, rank, sig, meta, payload,
+                              f"Allgather.bwd[{codec.name}]")
         offset = sum(counts[:rank])
         index = [slice(None)] * jnp.ndim(g)
         index[ax] = slice(offset, offset + counts[rank])

@@ -446,6 +446,11 @@ def allreduce(ctx, x, op: int, codec: Codec, algorithm=None,
         raise CommError(
             f"compressed Allreduce supports MPI_SUM only; got "
             f"{C.op_name(op)} — drop compression= for non-sum reductions")
+    # Finite guard hook (mpi4torch_tpu.resilience): off = x untouched,
+    # zero added ops; a non-finite gradient entering the quantized
+    # pipeline would otherwise saturate block scales silently.
+    from ..resilience import guards as _guards
+    x = _guards.spmd_finite_value(x, f"Allreduce[{codec.name}]")
     algo = resolve_algorithm(ctx.size, x, codec, algorithm,
                              algorithm_explicit)
 

@@ -506,15 +506,139 @@ def set_hier_group_size(g) -> None:
     _hier_group_size = g
 
 
+# ---------------------------------------------------------------------------
+# Fault tolerance (mpi4torch_tpu.resilience; ISSUE 7)
+# ---------------------------------------------------------------------------
+
+# Transient-fault retry budget of the eager rendezvous/p2p layer: a
+# barrier or receive that finds nothing within the base timeout gets
+# this many extra patience windows, each of capped-exponential-backoff
+# length, before declaring DeadlockError — a slow-but-alive rank (GC
+# pause, noisy neighbor, fault-injected delay) completes the collective
+# inside the extended window instead of tearing the world down.  0
+# (default) keeps the historical single-timeout behavior.
+_comm_retries = 0
+# Base backoff in seconds; retry k waits min(backoff * 2**(k-1), 30s).
+_comm_backoff = 0.05
+
+
+def comm_retries() -> int:
+    """Retry extensions granted to a timed-out rendezvous barrier or p2p
+    receive before it raises (mpi4torch_tpu.resilience)."""
+    return _comm_retries
+
+
+def set_comm_retries(n) -> None:
+    global _comm_retries
+    _comm_retries = _validated_threshold(n, "comm_retries",
+                                         unit="retry count")
+
+
+def comm_backoff() -> float:
+    """Base seconds of the capped exponential backoff between comm
+    retries (retry k waits ``min(comm_backoff * 2**(k-1), 30s)``)."""
+    return _comm_backoff
+
+
+def set_comm_backoff(seconds) -> None:
+    global _comm_backoff
+    try:
+        seconds = float(seconds)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"comm_backoff must be a number of seconds, got "
+            f"{seconds!r}") from None
+    if seconds < 0:
+        raise ValueError(f"comm_backoff must be >= 0, got {seconds}")
+    _comm_backoff = seconds
+
+
+# Non-finite payload guard of the collective layer: "off" (default —
+# the lowering is bit-identical to a guard-less build, HLO-censused in
+# bench.py _bench_guard_overhead), "warn" (IntegrityWarning naming the
+# offending rank(s) on the eager backend), or "raise" (IntegrityError).
+_GUARD_MODES = ("off", "warn", "raise")
+_comm_finite_guard = "off"
+
+
+def comm_finite_guard() -> str:
+    """Non-finite payload check mode of the collective ops
+    (mpi4torch_tpu.resilience.guards): ``"off"``/``"warn"``/``"raise"``.
+    Part of the trace-time fingerprint — toggling retraces Mode A."""
+    return _comm_finite_guard
+
+
+def set_comm_finite_guard(mode: str) -> None:
+    global _comm_finite_guard
+    if mode not in _GUARD_MODES:
+        raise ValueError(
+            f"comm_finite_guard must be one of {_GUARD_MODES}, got "
+            f"{mode!r}")
+    _comm_finite_guard = mode
+
+
+# Checksum leg of the compressed rendezvous wire (compress/eager.py):
+# when True, every encoded payload ships with a CRC of its wire bytes
+# and decode verifies each rank's block, raising IntegrityError naming
+# the corrupt contributor.  Off (default) keeps the wire format —
+# and the Mode A lowering — bit-identical to a checksum-less build.
+_comm_wire_checksum = False
+
+
+def comm_wire_checksum() -> bool:
+    """Whether the compressed eager wire carries a verified checksum
+    (mpi4torch_tpu.resilience.guards.wire_checksum)."""
+    return _comm_wire_checksum
+
+
+def set_comm_wire_checksum(value: bool) -> None:
+    global _comm_wire_checksum
+    _comm_wire_checksum = bool(value)
+
+
+# The active deterministic fault-injection plan
+# (mpi4torch_tpu.resilience.faults.FaultPlan), or None (default: the
+# zero-overhead fast path — one attribute read per rendezvous).
+# PROCESS-wide, not thread-scoped: faults must be visible inside
+# run_ranks rank-threads, which a thread-local scope opened outside
+# them would miss; resilience.fault_scope() is the save/restore wrapper.
+_fault_plan = None
+
+
+def fault_plan():
+    """The active fault-injection plan (or None).  See
+    :mod:`mpi4torch_tpu.resilience`."""
+    return _fault_plan
+
+
+def set_fault_plan(plan) -> None:
+    """Install a process-wide fault plan: a
+    :class:`~mpi4torch_tpu.resilience.FaultPlan`, a sequence of
+    :class:`~mpi4torch_tpu.resilience.FaultSpec`, or None to clear."""
+    global _fault_plan
+    if plan is None:
+        _fault_plan = None
+        return
+    from .resilience.faults import as_plan
+
+    _fault_plan = as_plan(plan)
+
+
 def thresholds_fingerprint():
     """Hashable snapshot of every trace-time threshold/selection knob —
     ``run_spmd`` folds it into its jit cache key so overriding a
     threshold (or the autotuner writing a measured crossover) retraces
     instead of silently reusing the old lowering."""
+    # _comm_wire_checksum is deliberately NOT here: it is a Mode B
+    # (rendezvous wire) leg only and provably never moves the Mode A
+    # lowering (censused in bench.py _bench_guard_overhead and
+    # tests/test_resilience.py) — keying it in would force a full
+    # retrace/recompile for zero semantic effect.
     return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
-            _hier_group_size, _chain_unroll_max, _quant_hop_impl)
+            _hier_group_size, _chain_unroll_max, _quant_hop_impl,
+            _comm_finite_guard)
 
 
 @contextmanager

@@ -52,6 +52,7 @@ from .. import config as _config
 from .. import constants as C
 from .._compat import optimization_barrier as _opt_barrier
 from ..ops.spmd import _ring_table
+from ..resilience import guards as _guards
 from ..runtime import CommError
 from ..utils.profiling import bucket_scope
 from .bucketing import (flatten_buckets, flatten_shard_buckets,
@@ -203,6 +204,10 @@ def _pipeline_allreduce(comm, buckets: Sequence, op: int, *,
         vals[rank] = b
         for off, r in enumerate(recvs, start=1):
             vals[(rank - off) % n] = comm.Wait(r)
+        # Finite guard (mpi4torch_tpu.resilience) over the per-peer
+        # bucket contributions: a corrupt payload off the p2p wire is
+        # attributed to its sender before the fold can mix it in.
+        _guards.check_contributions(vals, "Iallreduce_tree")
         out = C.reduce_ordered(op, vals)
         # Completing the sends through JoinDummies keeps every Isend on
         # the differentiation path even though its Wait output is a pure

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import constants as C
+from ..resilience import guards as _guards
 from ..runtime import (
     REQ_IRECV,
     REQ_ISEND,
@@ -166,6 +167,11 @@ def allreduce(ctx: RankContext, x, op: int, algorithm=None,
         _check_concrete(v)
         sig = _shape_sig(v)
         vals = world.exchange(rank, ("Allreduce", op, algo_name, sig), v)
+        # Finite guard (mpi4torch_tpu.resilience): every rank holds the
+        # same contribution list, so a corrupt payload raises/warns
+        # SYMMETRICALLY with the offending rank named — before any fold
+        # can mix it into the result.  No-op when the guard is off.
+        _guards.check_contributions(vals, "Allreduce")
         va = jnp.asarray(v)
         if va.size >= _FOLD_ONCE_MIN and C.fold_applicable(op, va.dtype):
             # Every rank would compute the IDENTICAL ascending-rank fold;
@@ -240,6 +246,7 @@ def reduce_scatter(ctx: RankContext, x, op: int, scatteraxis: int):
         _check_concrete(v)
         vals = world.exchange(rank, ("Reduce_scatter", op, ax,
                                      _shape_sig(v)), v)
+        _guards.check_contributions(vals, "Reduce_scatter")
         # Slice each rank's contribution to MY segment first, then fold:
         # the element-wise fold commutes with slicing (bit-identical
         # result) at 1/size of the reduction work — the same shape
@@ -348,6 +355,7 @@ def reduce_(ctx: RankContext, x, op: int, root: int, algorithm=None):
         vals = world.exchange(rank, ("Reduce_", op, root,
                                      algorithm or "ring",
                                      _shape_sig(v)), v)
+        _guards.check_contributions(vals, "Reduce_")
         # Non-root ranks discard the reduction, so they only compute it
         # when the fold itself would raise (unsupported op, or an op the
         # dtype rejects — e.g. MPI_BAND on floats) — keeping the
@@ -463,6 +471,7 @@ def allgather(ctx: RankContext, x, gatheraxis: int):
         othershape = tuple(s for i, s in enumerate(v.shape) if i != ax)
         sig = ("Allgather", ax, othershape, str(jnp.asarray(v).dtype))
         vals = world.exchange(rank, sig, v)
+        _guards.check_contributions(vals, "Allgather")
         return jnp.concatenate(vals, axis=ax), tuple(v.shape[ax] for v in vals)
 
     def bwd_impl(counts, g):

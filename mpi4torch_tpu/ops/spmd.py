@@ -1059,6 +1059,14 @@ def allreduce(ctx: SpmdContext, x, op: int, algorithm=None,
     validation that only this backend can perform (e.g. a
     ``config.hier_group_size`` that does not divide THIS communicator):
     explicit requests raise, scope defaults degrade to ``ring``."""
+    # Finite guard (mpi4torch_tpu.resilience): trace-time hook — with
+    # config.comm_finite_guard off (default) this returns x untouched
+    # and the lowering is bit-identical to a guard-less build
+    # (HLO-censused in bench.py _bench_guard_overhead); "warn"/"raise"
+    # add an is_finite reduce + host callback.  The mode rides the
+    # thresholds fingerprint, so toggling retraces.
+    from ..resilience import guards as _guards
+    x = _guards.spmd_finite_value(x, "Allreduce")
     if algorithm is None:
         algorithm = _auto_allreduce_algorithm(ctx, x)
     if algorithm in ("hier", "torus") and ctx.size > 1:

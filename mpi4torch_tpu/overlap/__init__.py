@@ -44,6 +44,16 @@ rule: split-phase transfers are exact — an explicit overlap request
 plus an explicit codec raises, scope defaults degrade (a compressed
 bucket takes the blocking codec pipeline while its exact neighbors
 ride split-phase).
+
+Fault tolerance (mpi4torch_tpu.resilience): the eager split-phase forms
+and the fused ``overlap=`` Isend/Irecv pipeline funnel through the same
+rendezvous/mailbox chokepoints the fault-injection layer instruments,
+so a fault plan composes with deferred Waits without overlap-specific
+hooks — a dead rank surfaces as a rank-attributed ``RankFailedError``,
+a dropped pipeline message recovers under ``config.comm_retries``
+redelivery, and a corrupt bucket is caught by the finite guard naming
+its sender (the ``overlap`` column of the censused fault matrix,
+``make faults-smoke``; see doc/resilience.md).
 """
 
 from __future__ import annotations

@@ -1,0 +1,66 @@
+"""Fault-tolerant collectives: deterministic fault injection, failure
+attribution, retry/backoff, integrity guards, preemption-safe recovery.
+
+At the scale the north star targets (multi-pod, slow DCN tiers,
+preemptible capacity — "The Big Send-off", PAPERS.md) failure is the
+steady state.  This package makes the framework's failures:
+
+* **expressible and reproducible** — a deterministic fault-injection
+  layer (:mod:`.faults`): ``fault_scope``/``config.set_fault_plan``
+  inject faults keyed by ``(rank, op-kind, call-index)`` into the Mode B
+  rendezvous and p2p wire — rank death mid-collective, delayed arrival,
+  dropped messages, NaN/Inf payload corruption, bit-flips on the
+  encoded int8 wire, truncated checkpoint writes — so every subsystem's
+  failure behavior is a censused test matrix (:mod:`.matrix`,
+  ``make faults-smoke``) instead of a hope;
+* **attributable** — rendezvous timeouts carry ``arrived``/``missing``
+  rank sets (:class:`~mpi4torch_tpu.DeadlockError`), a dead rank raises
+  :class:`~mpi4torch_tpu.RankFailedError` *naming the rank* on every
+  survivor, corrupt payloads raise
+  :class:`~mpi4torch_tpu.IntegrityError` naming the contributor, and
+  ``comm.check_health()`` is a timeout-bounded attributed barrier
+  (:class:`~mpi4torch_tpu.HealthReport`);
+* **survivable** — transient faults (slow rank, dropped message) retry
+  with capped exponential backoff (``config.comm_retries`` /
+  ``comm_backoff``); integrity guards (``config.comm_finite_guard``,
+  ``config.comm_wire_checksum`` — :mod:`.guards`) catch lying payloads
+  with a bit-identical, HLO-censused zero-overhead off path; and
+  :func:`restore_or_init` (:mod:`.recovery`) survives mid-save kills by
+  falling back to the last complete checkpoint step.
+
+See ``doc/resilience.md`` for the fault-plan grammar, the knob table,
+and the recovery recipe.
+"""
+
+from __future__ import annotations
+
+from ..runtime import (DeadlockError, HealthReport, IntegrityError,
+                       RankFailedError)
+from .faults import (FAULT_KINDS, FaultKind, FaultPlan, FaultSpec,
+                     as_plan, fault_scope, register_fault_kind)
+from .guards import (IntegrityWarning, check_contributions,
+                     clear_violations, last_violation, spmd_finite_value,
+                     verify_wire, wire_checksum)
+from .recovery import restore_or_init
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultKind",
+    "FaultPlan",
+    "FaultSpec",
+    "as_plan",
+    "fault_scope",
+    "register_fault_kind",
+    "IntegrityWarning",
+    "check_contributions",
+    "spmd_finite_value",
+    "wire_checksum",
+    "verify_wire",
+    "last_violation",
+    "clear_violations",
+    "restore_or_init",
+    "DeadlockError",
+    "RankFailedError",
+    "IntegrityError",
+    "HealthReport",
+]

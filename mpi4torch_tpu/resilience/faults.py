@@ -1,0 +1,430 @@
+"""Deterministic fault injection for the Mode B rendezvous/p2p layer.
+
+Every subsystem built so far — plain collectives, fused buckets
+(mpi4torch_tpu.fuse), the compressed wire (compress/), split-phase
+handles and the overlap pipeline (overlap/, fuse overlap=True) —
+funnels its eager-mode communication through exactly two chokepoints:
+``World.exchange`` (the rendezvous) and ``World.p2p_send``/``p2p_recv``
+(the mailbox wire).  This module injects faults *there*, keyed by
+``(rank, op-kind, call-index)``, so a single plan grammar covers every
+composition without per-subsystem hooks, and a fault's behavior under
+fused buckets / per-hop codecs / deferred Waits is a *censused test
+matrix* (:mod:`.matrix`, ``make faults-smoke``) instead of a hope.
+
+Plan grammar::
+
+    plan = FaultPlan([
+        FaultSpec("delay", rank=2, op="Allreduce", seconds=0.5),
+        FaultSpec("rank_death", rank=1, op="Allreduce", index=3),
+        FaultSpec("bitflip", rank=0, op="Allgather.c"),
+    ])
+    with mpi.resilience.fault_scope(plan):
+        mpi.run_ranks(step, 8)
+
+* ``kind`` — a registered :class:`FaultKind` name (see
+  :data:`FAULT_KINDS`); registering a kind without
+  :mod:`.matrix` coverage fails CI (the PR 4/6 registry-sync guard).
+* ``rank`` — the injected rank (``None`` = any rank matches).
+* ``op`` — prefix of the rendezvous op token (the first element of the
+  exchange signature: ``"Allreduce"``, ``"Allgather.c"``, ...;
+  ``"p2p"`` for the mailbox wire, ``"ckpt_save"`` for checkpoint
+  writes; ``None`` = any).
+* ``index``/``count`` — fire on the ``index``-th .. ``index+count-1``-th
+  matching call *on that rank* (per-rank call counters make the
+  injection deterministic for a deterministic program).
+
+Faults are injected BEFORE the payload is deposited, so corruption
+rides the same wire as honest data and must be caught by the integrity
+guards (:mod:`.guards`), recovery rides the same retry/backoff as real
+transients (``config.comm_retries``), and a killed rank tears the
+rendezvous down through the same attribution path a real preemption
+would (:class:`~mpi4torch_tpu.RankFailedError`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime import CommError, RankFailedError, _P2P_DROPPED
+
+__all__ = [
+    "FaultKind",
+    "FaultSpec",
+    "FaultPlan",
+    "FAULT_KINDS",
+    "register_fault_kind",
+    "fault_scope",
+    "as_plan",
+]
+
+
+@dataclass(frozen=True)
+class FaultKind:
+    """A registered fault class: its name, the injection sites it can
+    fire at, and whether it is *transient* (recoverable within
+    ``config.comm_retries``/``comm_backoff`` or the checkpoint fallback)
+    or *permanent* (must raise its typed, rank-attributed error).
+
+    ``sites`` ⊆ {"exchange", "p2p", "checkpoint"}."""
+    name: str
+    sites: FrozenSet[str]
+    transient: bool
+    doc: str
+
+
+FAULT_KINDS: Dict[str, FaultKind] = {}
+
+
+def register_fault_kind(kind: FaultKind) -> FaultKind:
+    """Register a fault kind.  The :mod:`.matrix` registry-sync guard
+    makes an unregistered-covered or registered-uncovered kind fail CI —
+    register AND add a coverage row, or the suite tells you."""
+    if not kind.sites <= {"exchange", "p2p", "checkpoint"}:
+        raise ValueError(f"unknown fault sites {sorted(kind.sites)}")
+    FAULT_KINDS[kind.name] = kind
+    return kind
+
+
+register_fault_kind(FaultKind(
+    "rank_death", frozenset({"exchange", "p2p"}), transient=False,
+    doc="the rank dies mid-collective (simulated preemption): it raises "
+        "RankFailedError and every peer blocked on the rendezvous gets "
+        "the same typed error naming the dead rank"))
+register_fault_kind(FaultKind(
+    "delay", frozenset({"exchange", "p2p"}), transient=True,
+    doc="the rank arrives `seconds` late: recovered within "
+        "config.comm_retries backoff extensions, else attributed "
+        "DeadlockError (arrived/missing rank sets) on the punctual ranks"))
+register_fault_kind(FaultKind(
+    "drop_p2p", frozenset({"p2p"}), transient=True,
+    doc="the message vanishes off the mailbox wire: the receiver's retry "
+        "triggers redelivery (the NACK-retransmission analogue), else "
+        "DeadlockError"))
+register_fault_kind(FaultKind(
+    "corrupt_nan", frozenset({"exchange", "p2p"}), transient=False,
+    doc="a NaN is written into the rank's float payload: detected by "
+        "config.comm_finite_guard as IntegrityError naming the rank"))
+register_fault_kind(FaultKind(
+    "corrupt_inf", frozenset({"exchange", "p2p"}), transient=False,
+    doc="an Inf is written into the rank's float payload: detected by "
+        "config.comm_finite_guard as IntegrityError naming the rank"))
+register_fault_kind(FaultKind(
+    "bitflip", frozenset({"exchange", "p2p"}), transient=False,
+    doc="a low bit flips in the rank's encoded integer wire block (the "
+        "int8/int16 codec payload): detected by config.comm_wire_checksum "
+        "as IntegrityError naming the rank; float payloads have no "
+        "eligible leaf, so the fault is inert off the compressed wire"))
+register_fault_kind(FaultKind(
+    "truncate_save", frozenset({"checkpoint"}), transient=True,
+    doc="the checkpoint write is killed mid-save (the just-written step's "
+        "largest file is truncated): resilience.restore_or_init falls "
+        "back to the last complete step"))
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: WHAT (``kind``), WHERE (``rank`` × ``op``),
+    WHEN (``index``/``count`` among that rank's matching calls), plus
+    kind-specific parameters (``seconds`` for ``delay``, ``nflips`` for
+    ``bitflip``)."""
+    kind: str
+    rank: Optional[int] = None
+    op: Optional[str] = None
+    index: int = 0
+    count: int = 1
+    seconds: float = 0.25
+    nflips: int = 1
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; registered kinds: "
+                f"{sorted(FAULT_KINDS)}")
+        if self.index < 0 or self.count < 1:
+            raise ValueError("FaultSpec needs index >= 0 and count >= 1")
+
+
+@dataclass
+class FiredFault:
+    """Ledger entry: a fault that actually acted on a payload/rank."""
+    kind: str
+    rank: int
+    op: str
+    site: str
+
+
+class FaultPlan:
+    """A set of :class:`FaultSpec` with deterministic per-(spec, rank)
+    call counters and a fired-fault ledger (the test matrix's evidence
+    that a cell actually exercised its fault rather than passing
+    vacuously)."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        self.specs: Tuple[FaultSpec, ...] = tuple(
+            s if isinstance(s, FaultSpec) else FaultSpec(**s)
+            for s in specs)
+        self._counts: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+        self.fired: List[FiredFault] = []
+
+    # ------------------------------------------------------------ match
+
+    def _matching(self, site: str, rank: int, op: str):
+        """(spec-index, spec) pairs firing NOW for this (site, rank, op)
+        call — each matching spec's per-rank counter advances exactly
+        once per call, so the index window is deterministic.  Corruption
+        kinds REFUND the counter when a call carried no eligible leaf
+        (:meth:`_refund`), so their call-index counts eligible wire
+        payloads, not protocol chatter."""
+        out = []
+        with self._lock:
+            for i, spec in enumerate(self.specs):
+                kind = FAULT_KINDS[spec.kind]
+                if site not in kind.sites:
+                    continue
+                if spec.rank is not None and spec.rank != rank:
+                    continue
+                if spec.op is not None and not op.startswith(spec.op):
+                    continue
+                seen = self._counts.get((i, rank), 0)
+                self._counts[(i, rank)] = seen + 1
+                if spec.index <= seen < spec.index + spec.count:
+                    out.append((i, spec))
+        return out
+
+    def _refund(self, spec_idx: int, rank: int) -> None:
+        with self._lock:
+            self._counts[(spec_idx, rank)] -= 1
+
+    def _note(self, spec: FaultSpec, rank: int, op: str, site: str):
+        with self._lock:
+            self.fired.append(FiredFault(spec.kind, rank, op, site))
+
+    def fired_kinds(self) -> FrozenSet[str]:
+        with self._lock:
+            return frozenset(f.kind for f in self.fired)
+
+    def wants_checkpoint(self) -> bool:
+        """Cheap pre-check for the checkpoint layer: does any spec
+        target the checkpoint site at all?  (The checkpoint hook has to
+        force a synchronous finalize before damaging files, which it
+        must not do for plans that never touch checkpoints.)"""
+        return any("checkpoint" in FAULT_KINDS[s.kind].sites
+                   for s in self.specs)
+
+    # ------------------------------------------------------- injection
+
+    def on_exchange(self, world, rank: int, signature, payload):
+        """Runtime hook: called by ``World.exchange`` before the payload
+        is deposited.  May sleep (delay), raise (rank_death — after
+        ``world.mark_dead`` so peers attribute promptly), or return a
+        corrupted payload."""
+        op = str(signature[0])
+        for i, spec in self._matching("exchange", rank, op):
+            payload = self._fire(i, spec, world, rank, op, "exchange",
+                                 payload)
+        return payload
+
+    def on_p2p_send(self, world, src: int, dst: int, tag: int, payload):
+        """Runtime hook: called by ``World.p2p_send``.  Same actions as
+        the exchange hook, plus ``drop_p2p`` (returns the runtime's drop
+        sentinel after stashing the payload for retry redelivery).
+        Every matched spec fires even when one of them is a drop — the
+        drop is applied LAST, so a co-matched delay/corruption is not
+        silently swallowed with its index window already consumed (and
+        behavior does not depend on spec order)."""
+        drop_spec = None
+        for i, spec in self._matching("p2p", src, "p2p"):
+            if spec.kind == "drop_p2p":
+                drop_spec = spec
+                continue
+            payload = self._fire(i, spec, world, src, "p2p", "p2p",
+                                 payload)
+        if drop_spec is not None:
+            with world._mb_lock:
+                world._dropped.setdefault(
+                    (src, dst, tag), []).append(payload)
+            self._note(drop_spec, src, "p2p", "p2p")
+            return _P2P_DROPPED
+        return payload
+
+    def on_checkpoint_save(self, path: str, rank: int = 0) -> None:
+        """Checkpoint hook: called by utils/checkpoint.py after a save
+        finalizes, with the step directory.  ``truncate_save`` damages
+        the just-written step — the deterministic stand-in for a kill
+        mid-save on storage without atomic rename."""
+        for i, spec in self._matching("checkpoint", rank, "ckpt_save"):
+            if spec.kind == "truncate_save":
+                if _truncate_tree(path):
+                    self._note(spec, rank, "ckpt_save", "checkpoint")
+                else:
+                    self._refund(i, rank)
+
+    def _fire(self, spec_idx: int, spec: FaultSpec, world, rank: int,
+              op: str, site: str, payload):
+        if spec.kind == "delay":
+            self._note(spec, rank, op, site)
+            time.sleep(spec.seconds)
+            return payload
+        if spec.kind == "rank_death":
+            self._note(spec, rank, op, site)
+            err = RankFailedError(
+                f"rank {rank} was killed by fault injection during {op} "
+                "(simulated preemption)", ranks=(rank,))
+            world.mark_dead(rank, err)
+            raise err
+        if spec.kind in ("corrupt_nan", "corrupt_inf"):
+            value = float("nan") if spec.kind == "corrupt_nan" \
+                else float("inf")
+            payload, hit = _map_first_leaf(
+                payload, _is_float_leaf, lambda a: _poison(a, value))
+            if hit:
+                self._note(spec, rank, op, site)
+            else:
+                # No eligible leaf: the window is not consumed, so the
+                # spec keeps hunting for the first corruptible payload.
+                self._refund(spec_idx, rank)
+            return payload
+        if spec.kind == "bitflip":
+            payload, hit = _map_first_leaf(
+                payload, _is_int_wire_leaf,
+                lambda a: _flip_bits(a, spec.nflips))
+            if hit:
+                self._note(spec, rank, op, site)
+            else:
+                self._refund(spec_idx, rank)
+            return payload
+        raise CommError(
+            f"fault kind {spec.kind!r} has no injection action for site "
+            f"{site!r}")
+
+
+# ---------------------------------------------------------------- mutation
+
+def _is_float_leaf(leaf) -> bool:
+    import jax.numpy as jnp
+
+    return (hasattr(leaf, "dtype") and getattr(leaf, "size", 0) > 0
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def _is_int_wire_leaf(leaf) -> bool:
+    """Encoded wire blocks only: integer-typed ndarrays (the int8 q8
+    payload, int16/uint16 words...).  Python ints (counts, roots) are
+    protocol data, not wire payload, and have no ``dtype``."""
+    import jax.numpy as jnp
+
+    return (hasattr(leaf, "dtype") and getattr(leaf, "size", 0) > 0
+            and jnp.issubdtype(leaf.dtype, jnp.integer))
+
+
+def _map_first_leaf(payload, pred, fn):
+    """Functionally replace the FIRST pytree leaf satisfying ``pred``;
+    returns ``(new_payload, fired)``.  Deterministic: pytree leaf order
+    is canonical, so the same plan always corrupts the same leaf."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(payload)
+    for i, leaf in enumerate(leaves):
+        if pred(leaf):
+            leaves[i] = fn(leaf)
+            return jax.tree_util.tree_unflatten(treedef, leaves), True
+    return payload, False
+
+
+def _poison(leaf, value: float):
+    # Host-side numpy mutation (not a jnp .at[] update): injection must
+    # not pay a jit compile on its first firing — a compile pause would
+    # make the injected rank LATE as a side effect, turning a corruption
+    # cell into a spurious timeout.
+    a = np.array(np.asarray(leaf), copy=True)
+    a.reshape(-1)[0] = a.dtype.type(value)
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)
+
+
+def _flip_bits(leaf, nflips: int):
+    a = np.array(np.asarray(leaf), copy=True)
+    view = a.view(np.uint8).reshape(-1)
+    for k in range(max(int(nflips), 1)):
+        # Advance the BIT once the byte index wraps: revisiting a byte
+        # with the same mask would flip it back, silently undoing the
+        # corruption while the fired ledger claims it acted.
+        view[k % view.size] ^= np.uint8(1 << ((k // view.size) % 8))
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)
+
+
+def _truncate_tree(path: str) -> bool:
+    """Damage a checkpoint step directory the way a mid-save kill on
+    non-atomic storage would: the LARGEST regular file (ties broken
+    lexicographically — deterministic) is cut to half its size.  Returns
+    whether anything was damaged."""
+    import os
+
+    best = None
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            p = os.path.join(root, name)
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                continue
+            if size > 0 and (best is None or (-size, p) < best[0]):
+                best = ((-size, p), p, size)
+    if best is None:
+        return False
+    _key, p, size = best
+    with open(p, "r+b") as f:
+        f.truncate(size // 2)
+    return True
+
+
+# ---------------------------------------------------------------- scoping
+
+def as_plan(plan) -> FaultPlan:
+    """Coerce a FaultPlan / FaultSpec / sequence-of-specs to a plan."""
+    if isinstance(plan, FaultPlan):
+        return plan
+    if isinstance(plan, FaultSpec):
+        return FaultPlan([plan])
+    return FaultPlan(list(plan))
+
+
+class fault_scope:
+    """Install a fault plan for a ``with`` block::
+
+        with mpi.resilience.fault_scope([
+                mpi.resilience.FaultSpec("delay", rank=1, seconds=0.3)]):
+            mpi.run_ranks(step, 4)
+
+    PROCESS-wide (``config.set_fault_plan``), unlike the thread-scoped
+    compression/algorithm scopes: faults must be visible inside the
+    rank-threads ``run_ranks`` spawns, which a thread-local scope opened
+    outside them could never be.  The previous plan is restored on exit.
+    Yields the installed :class:`FaultPlan` (its ``fired`` ledger is the
+    test matrix's proof a fault actually acted)."""
+
+    def __init__(self, plan):
+        self._plan = as_plan(plan)
+        self._prev = None
+
+    def __enter__(self) -> FaultPlan:
+        from .. import config as _cfg
+
+        self._prev = _cfg.fault_plan()
+        _cfg.set_fault_plan(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc):
+        from .. import config as _cfg
+
+        _cfg.set_fault_plan(self._prev)
+        return False

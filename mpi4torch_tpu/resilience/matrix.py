@@ -1,0 +1,330 @@
+"""The censused fault matrix: every fault kind × one representative
+collective per subsystem, with a typed expected outcome per cell.
+
+ONE implementation shared by the tier-1 tests (tests/test_resilience.py
+runs a fast subset + the full matrix on the ``slow`` lane) and the
+``make faults-smoke`` lane (:mod:`.__main__`) — the PR 4/6
+registry-sync-guard pattern: :data:`COVERAGE` is the literal coverage
+table, and a :class:`~.faults.FaultKind` registered without a matrix
+row (or a row for an unregistered kind) fails CI, so fault kinds cannot
+ship untested.
+
+Cell outcomes:
+
+* ``"raise"`` — the fault must surface as its TYPED, rank-ATTRIBUTED
+  error (:data:`EXPECTED_ERROR`): ``err.ranks`` names the injected rank.
+* ``"recover"`` — a transient fault: with ``config.comm_retries``/
+  ``comm_backoff`` configured, the collective completes and the result
+  is BITWISE equal to the fault-free baseline, and the plan's fired
+  ledger proves the fault actually acted (no vacuous pass).
+* ``"inert"`` — the fault has no eligible target in this subsystem
+  (``drop_p2p`` off the p2p wire, ``bitflip`` off the integer-encoded
+  wire): the plan must NOT fire and the result must stay bitwise exact
+  — "not triggered" is itself a censused claim, not a silent gap.
+
+Representative collectives (Mode B, where the rendezvous faults live):
+``plain`` = ``Allreduce``; ``fused`` = ``Allreduce_tree`` split into
+several buckets; ``compressed`` = q8 ``Allreduce`` (the in-schedule
+hop-oracle wire) — except ``bitflip``, whose encoded-int8-wire target is
+the q8 ``Allgather`` rendezvous wire; ``overlap`` = the fused
+``overlap=2`` Isend/Irecv pipeline.  Worlds: ``(3,)``, ``(8,)``, and
+the (2,4)-factorized 8-rank world (``algorithm="torus"`` — the 2-level
+striped schedule over the hier group rule).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..runtime import (DeadlockError, IntegrityError, RankFailedError)
+from .faults import FAULT_KINDS, FaultPlan, FaultSpec, fault_scope
+
+__all__ = ["COVERAGE", "EXPECTED_ERROR", "COMM_SUBSYSTEMS", "WORLDS",
+           "run_cell", "run_checkpoint_cell", "coverage_cells"]
+
+COMM_SUBSYSTEMS = ("plain", "fused", "compressed", "overlap")
+
+# The literal coverage table (registry-sync guarded against FAULT_KINDS).
+COVERAGE: Dict[str, Dict[str, str]] = {
+    "rank_death": {"plain": "raise", "fused": "raise",
+                   "compressed": "raise", "overlap": "raise"},
+    "delay": {"plain": "recover", "fused": "recover",
+              "compressed": "recover", "overlap": "recover"},
+    "drop_p2p": {"plain": "inert", "fused": "inert",
+                 "compressed": "inert", "overlap": "recover"},
+    "corrupt_nan": {"plain": "raise", "fused": "raise",
+                    "compressed": "raise", "overlap": "raise"},
+    "corrupt_inf": {"plain": "raise", "fused": "raise",
+                    "compressed": "raise", "overlap": "raise"},
+    "bitflip": {"plain": "inert", "fused": "inert",
+                "compressed": "raise", "overlap": "inert"},
+    "truncate_save": {"checkpoint": "recover"},
+}
+
+EXPECTED_ERROR = {
+    "rank_death": RankFailedError,
+    "corrupt_nan": IntegrityError,
+    "corrupt_inf": IntegrityError,
+    "bitflip": IntegrityError,
+    "delay": DeadlockError,        # the UNrecovered form (retries=0)
+    "drop_p2p": DeadlockError,     # the UNrecovered form
+}
+
+# The matrix worlds: flat 3, flat 8, and 8 as the (2,4) virtual torus.
+WORLDS = ((3, None), (8, None), (8, "torus"))
+
+# Cell timing: a small world-timeout keeps the failure cells fast; the
+# retry budget must out-wait DELAY_S for the recover cells
+# (0.15 + 0.3 + 0.6 + ... capped, on top of the 0.3s base window).
+CELL_TIMEOUT_S = 0.3
+DELAY_S = 0.5
+RETRIES = 5
+BACKOFF_S = 0.15
+
+
+def _cell_fn(subsystem: str, kind: str, algorithm: Optional[str]):
+    """The per-rank body of a matrix cell and the op-token prefix its
+    fault spec targets.  Data is deterministic per rank; every cell
+    returns a pytree of concrete arrays for bitwise comparison."""
+    import jax.numpy as jnp
+
+    import mpi4torch_tpu as mpi
+
+    comm = mpi.COMM_WORLD
+
+    if subsystem == "plain":
+        def fn(rank):
+            x = jnp.arange(64, dtype=jnp.float32) * (rank + 1)
+            return comm.Allreduce(x, mpi.MPI_SUM, algorithm=algorithm)
+        return fn, "Allreduce"
+
+    if subsystem == "fused":
+        def fn(rank):
+            tree = {"a": jnp.arange(24, dtype=jnp.float32) * (rank + 1),
+                    "b": jnp.ones(8, jnp.float32) * rank}
+            return comm.Allreduce_tree(tree, mpi.MPI_SUM, bucket_bytes=64)
+        return fn, "Allreduce"
+
+    if subsystem == "compressed":
+        if kind == "bitflip":
+            # The encoded-int8-wire representative: the q8 Allgather's
+            # rendezvous wire really carries int8 blocks in Mode B (the
+            # q8 Allreduce rides the hop-ORACLE there, whose exchanged
+            # contributions are raw floats — no int8 leaf to flip).
+            def fn(rank):
+                x = jnp.linspace(-2.0, 2.0, 48,
+                                 dtype=jnp.float32) * (rank + 1)
+                return comm.Allgather(x, 0, compression="q8")
+            return fn, "Allgather.c"
+
+        def fn(rank):
+            x = jnp.linspace(-2.0, 2.0, 96,
+                             dtype=jnp.float32) * (rank + 1)
+            return comm.Allreduce(x, mpi.MPI_SUM, compression="q8",
+                                  algorithm=algorithm)
+        return fn, "Allreduce"
+
+    if subsystem == "overlap":
+        def fn(rank):
+            tree = {"a": jnp.arange(24, dtype=jnp.float32) * (rank + 1),
+                    "b": jnp.ones(8, jnp.float32) * rank}
+            return comm.Allreduce_tree(tree, mpi.MPI_SUM, bucket_bytes=64,
+                                       overlap=2)
+        # The eager overlap pipeline's comm entry points are the
+        # Isend/Irecv mailboxes: target the p2p site (op=None would also
+        # match, but the explicit token documents the wire).
+        return fn, "p2p" if kind in ("drop_p2p",) else None
+
+    raise ValueError(f"unknown matrix subsystem {subsystem!r}")
+
+
+def _tree_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb or len(la) != len(lb):
+        return False
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+class _knob:
+    """Save/restore a set of process-wide config knobs around a cell."""
+
+    def __init__(self, **kw):
+        self._kw = kw
+
+    def __enter__(self):
+        from .. import config as _cfg
+
+        self._prev = {}
+        setters = {"comm_retries": _cfg.set_comm_retries,
+                   "comm_backoff": _cfg.set_comm_backoff,
+                   "comm_finite_guard": _cfg.set_comm_finite_guard,
+                   "comm_wire_checksum": _cfg.set_comm_wire_checksum}
+        getters = {"comm_retries": _cfg.comm_retries,
+                   "comm_backoff": _cfg.comm_backoff,
+                   "comm_finite_guard": _cfg.comm_finite_guard,
+                   "comm_wire_checksum": _cfg.comm_wire_checksum}
+        for k, v in self._kw.items():
+            self._prev[k] = getters[k]()
+            setters[k](v)
+        self._setters = setters
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._prev.items():
+            self._setters[k](v)
+        return False
+
+
+_baselines: Dict[tuple, list] = {}
+
+
+def _baseline(subsystem: str, kind: str, nranks: int,
+              algorithm: Optional[str]):
+    """Fault-free reference results, cached per cell shape (the fn is a
+    pure function of rank, so one baseline serves every kind sharing the
+    representative collective)."""
+    import mpi4torch_tpu as mpi
+
+    rep = "allgather" if (subsystem, kind) == ("compressed", "bitflip") \
+        else subsystem
+    key = (rep, nranks, algorithm)
+    if key not in _baselines:
+        fn, _op = _cell_fn(subsystem, kind, algorithm)
+        _baselines[key] = mpi.run_ranks(fn, nranks, timeout=30.0)
+    return _baselines[key]
+
+
+def run_cell(kind: str, subsystem: str, nranks: int = 3,
+             algorithm: Optional[str] = None) -> dict:
+    """Run one matrix cell; returns a verdict record with ``status``
+    ``"ok"`` or ``"fail"`` and a human-readable ``detail``."""
+    import mpi4torch_tpu as mpi
+
+    expected = COVERAGE.get(kind, {}).get(subsystem)
+    if expected is None:
+        return {"kind": kind, "subsystem": subsystem, "nranks": nranks,
+                "status": "fail",
+                "detail": "no COVERAGE row — the registry-sync guard "
+                          "should have caught this"}
+    target = 1 if nranks > 1 else 0
+    fn, op_prefix = _cell_fn(subsystem, kind, algorithm)
+    baseline = _baseline(subsystem, kind, nranks, algorithm)
+
+    spec = FaultSpec(kind, rank=target, op=op_prefix, seconds=DELAY_S)
+    knobs = {}
+    if expected == "recover":
+        knobs.update(comm_retries=RETRIES, comm_backoff=BACKOFF_S)
+    if kind in ("corrupt_nan", "corrupt_inf"):
+        knobs.update(comm_finite_guard="raise")
+    if kind == "bitflip":
+        knobs.update(comm_wire_checksum=True)
+
+    got, err = None, None
+    with _knob(**knobs), fault_scope([spec]) as plan:
+        try:
+            got = mpi.run_ranks(fn, nranks, timeout=CELL_TIMEOUT_S)
+        except Exception as e:  # noqa: BLE001 — classified below
+            err = e
+
+    rec = {"kind": kind, "subsystem": subsystem, "nranks": nranks,
+           "algorithm": algorithm, "expected": expected,
+           "fired": sorted(plan.fired_kinds())}
+
+    def fail(detail):
+        rec.update(status="fail", detail=detail)
+        return rec
+
+    if expected == "raise":
+        want = EXPECTED_ERROR[kind]
+        if err is None:
+            return fail(f"fault went UNDETECTED: expected {want.__name__}, "
+                        "collective completed")
+        if not isinstance(err, want):
+            return fail(f"expected {want.__name__}, got "
+                        f"{type(err).__name__}: {err}")
+        ranks = getattr(err, "ranks", frozenset())
+        if target not in ranks:
+            return fail(f"{want.__name__} is UNATTRIBUTED: expected rank "
+                        f"{target} in {sorted(ranks)}")
+        rec.update(status="ok", detail=f"{want.__name__} naming rank "
+                                       f"{sorted(ranks)}")
+        return rec
+
+    if err is not None:
+        return fail(f"expected {expected}, got "
+                    f"{type(err).__name__}: {err}")
+    if not _tree_equal(got, baseline):
+        return fail("result DIVERGES from the fault-free baseline "
+                    "(silent corruption)")
+    fired = plan.fired_kinds()
+    if expected == "recover" and kind not in fired:
+        return fail("vacuous pass: the fault never fired "
+                    f"(fired={sorted(fired)})")
+    if expected == "inert" and kind in fired:
+        return fail("fault fired on a subsystem declared inert for it")
+    rec.update(status="ok",
+               detail="recovered bitwise" if expected == "recover"
+               else "inert (no eligible target), result bitwise exact")
+    return rec
+
+
+def run_checkpoint_cell(workdir: str) -> dict:
+    """The ``truncate_save`` × checkpoint cell: three saved steps, the
+    LAST save killed mid-write by the fault plan;
+    :func:`~.recovery.restore_or_init` must fall back to the previous
+    complete step bit-for-bit."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..utils.checkpoint import CheckpointManager
+    from .recovery import restore_or_init
+
+    rec = {"kind": "truncate_save", "subsystem": "checkpoint",
+           "expected": "recover"}
+
+    def state_at(step):
+        return {"w": jnp.arange(6, dtype=jnp.float32) * (step + 1),
+                "step": jnp.asarray(step, jnp.int32)}
+
+    spec = FaultSpec("truncate_save", rank=0, op="ckpt_save", index=2)
+    with fault_scope([spec]) as plan:
+        with CheckpointManager(workdir) as mgr:
+            for step in range(3):
+                mgr.save(step, state_at(step), force=True)
+            mgr.wait_until_finished()
+    if "truncate_save" not in plan.fired_kinds():
+        rec.update(status="fail",
+                   detail="vacuous pass: the save fault never fired")
+        return rec
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, step = restore_or_init(workdir, template=state_at(0))
+    if step != 1:
+        rec.update(status="fail",
+                   detail=f"expected fallback to step 1, got {step}")
+        return rec
+    want = state_at(1)
+    if not all(np.array_equal(np.asarray(state[k]), np.asarray(want[k]))
+               for k in want):
+        rec.update(status="fail",
+                   detail="fallback state diverges from step 1")
+        return rec
+    rec.update(status="ok", detail="mid-save kill fell back to the last "
+                                   "complete step bit-for-bit")
+    return rec
+
+
+def coverage_cells():
+    """Every (kind, subsystem) cell the coverage table declares, in a
+    deterministic order — what the smoke lane iterates and what the
+    registry-sync guard cross-checks against :data:`FAULT_KINDS`."""
+    for kind in sorted(COVERAGE):
+        for subsystem in COVERAGE[kind]:
+            yield kind, subsystem

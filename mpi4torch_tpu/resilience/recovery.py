@@ -1,0 +1,91 @@
+"""Preemption-safe checkpoint recovery.
+
+``utils/checkpoint.py``'s orbax discipline already makes a *single*
+save atomic (temp dir + rename), but production storage is not always
+atomic end-to-end and preempted jobs die mid-save anyway: the
+``truncate_save`` fault kind (:mod:`.faults`) models exactly that —
+the newest step directory exists but its data is torn.  A naive resume
+loop (``restore(latest_step())``) crashes on it and the job loses ALL
+its checkpoints' worth of work to one bad write.
+
+:func:`restore_or_init` is the survivable resume verb: walk the step
+history newest-first, restore the first step that actually loads, skip
+garbage (truncated data, a stray non-numeric directory, a step dir a
+concurrent cleaner half-removed) with a warning instead of a crash,
+and fall back to the initial state only when nothing usable remains::
+
+    state, step = mpi.resilience.restore_or_init(workdir, template=state)
+    for step in range(0 if step is None else step + 1, n_steps):
+        state = train_step(state)
+        mgr.save(step, state)
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Optional, Tuple
+
+__all__ = ["restore_or_init"]
+
+
+def _scan_steps(directory: str):
+    """Filesystem fallback for step discovery: numeric child directories,
+    newest first.  Used when the manager's own ``all_steps`` chokes
+    (e.g. on garbage entries some orbax versions refuse to parse)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for name in names:
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            steps.append(int(name))
+    return sorted(steps, reverse=True)
+
+
+def restore_or_init(directory: str, template: Any, *,
+                    init: Any = None,
+                    max_to_keep: Optional[int] = None
+                    ) -> Tuple[Any, Optional[int]]:
+    """Restore the newest *loadable* checkpoint under ``directory`` into
+    ``template``'s structure, falling back step by step past corrupt or
+    partial saves; returns ``(state, step)``.
+
+    ``step`` is the restored step number, or ``None`` when no usable
+    checkpoint exists — then ``state`` is ``init`` (or ``template``
+    itself when ``init`` is not given), i.e. a fresh start.  Unusable
+    steps (truncated mid-save, garbage directories) are *skipped with a
+    warning*, never fatal: surviving a torn write is the whole point
+    (ISSUE 7 tentpole, preemption-safe recovery)."""
+    from ..utils.checkpoint import CheckpointManager
+
+    state_init = template if init is None else init
+    if not os.path.isdir(directory):
+        return state_init, None
+    try:
+        with CheckpointManager(directory, max_to_keep=max_to_keep) as mgr:
+            steps = sorted(mgr.all_steps(), reverse=True)
+    except Exception as e:  # noqa: BLE001 — a broken dir must not kill resume
+        warnings.warn(
+            f"checkpoint step discovery failed ({type(e).__name__}: {e}); "
+            "falling back to a directory scan",
+            RuntimeWarning, stacklevel=2)
+        steps = _scan_steps(directory)
+    for step in steps:
+        # A FRESH manager per attempt: orbax latches item layouts it
+        # inspected — a failed restore of a garbage step would poison
+        # every later restore on the same manager instance.  Recovery is
+        # a cold-start path; the extra constructions are noise.
+        try:
+            with CheckpointManager(directory,
+                                   max_to_keep=max_to_keep) as mgr:
+                state = mgr.restore(step, template=template)
+        except Exception as e:  # noqa: BLE001 — torn step: fall back
+            warnings.warn(
+                f"checkpoint step {step} is unusable "
+                f"({type(e).__name__}); falling back to the previous "
+                "complete step", RuntimeWarning, stacklevel=2)
+            continue
+        return state, step
+    return state_init, None

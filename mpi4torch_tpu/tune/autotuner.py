@@ -39,7 +39,11 @@ from typing import Dict, Iterable, List, Optional, Sequence
 from .. import config as _config
 from .registry import available_algorithms, get_algorithm
 
-CACHE_VERSION = 1
+# v2: per-size measurement switched from median-of-k to MIN-of-k
+# (ISSUE 7 satellite — a single preempted/GC-hit sample could poison a
+# persisted winner under the median with few iters); winners measured
+# under the old rule are discarded by the version gate.
+CACHE_VERSION = 2
 
 _mem: Dict[str, dict] = {}
 _from_disk: set = set()
@@ -301,9 +305,17 @@ def _candidates(nranks: int, collective: str = "allreduce") -> List[str]:
 
 
 def _time_step(step, x, iters: int) -> float:
-    """Median seconds/step with a host fetch per iteration (the only
+    """MIN-of-k seconds/step with a host fetch per iteration (the only
     completion barrier remote runtimes honor — see bench.py ``_force``;
-    ``np.asarray`` of one output leaf is the cheap equivalent here)."""
+    ``np.asarray`` of one output leaf is the cheap equivalent here).
+
+    Min, not median/mean: timing noise on shared or preemptible
+    capacity is strictly one-sided — a preempted slice, a GC pause, or
+    a noisy neighbor only ever makes a sample SLOWER — so the minimum
+    is the robust estimator of the true step cost, and one bad sample
+    can no longer flip a persisted cache winner (with the old
+    median-of-5, TWO outliers among five samples poisoned the key for
+    every later process).  Keyed into :data:`CACHE_VERSION`."""
     import jax
     import numpy as np
 
@@ -318,8 +330,7 @@ def _time_step(step, x, iters: int) -> float:
         t0 = time.perf_counter()
         force(step(x))
         times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]
+    return min(times)
 
 
 def autotune_allreduce(sizes: Optional[Sequence[int]] = None,

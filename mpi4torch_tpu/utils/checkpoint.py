@@ -39,6 +39,23 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _post_save_fault(path: str) -> None:
+    """Deterministic fault-injection hook (mpi4torch_tpu.resilience):
+    when the active fault plan targets checkpoint saves
+    (``truncate_save``), damage the just-finalized write the way a kill
+    mid-save on non-atomic storage would — the recovery path
+    (:func:`mpi4torch_tpu.resilience.restore_or_init`) must survive it
+    by falling back to the last complete step.  Zero overhead when no
+    plan targets checkpoints (one attribute read)."""
+    from .. import config as _cfg
+    from ..runtime import effective_rank_context
+
+    plan = _cfg.fault_plan()
+    if plan is None or not plan.wants_checkpoint():
+        return
+    plan.on_checkpoint_save(path, rank=effective_rank_context().rank)
+
+
 def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
     """Write pytree ``state`` to directory ``path`` (created; absolute
     paths required by orbax — relative inputs are resolved here).
@@ -52,6 +69,7 @@ def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
         ckptr.wait_until_finished()
     finally:
         ckptr.close()
+    _post_save_fault(path)
 
 
 def restore_checkpoint(path: str, template: Any) -> Any:
@@ -108,7 +126,36 @@ class CheckpointManager:
 
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                                force=force)
+        if saved:
+            from .. import config as _cfg
+
+            plan = _cfg.fault_plan()
+            if plan is not None and plan.wants_checkpoint():
+                # Only under an active checkpoint-targeting fault plan:
+                # finalize synchronously so the step's files exist
+                # before the injected mid-save kill damages them.
+                self._mgr.wait_until_finished()
+                _post_save_fault(self._step_path(step))
         return bool(saved)
+
+    def _step_path(self, step: int) -> str:
+        """Directory of checkpoint ``step`` (best-effort across orbax
+        layouts: the default ``<dir>/<step>``, else the child dir whose
+        trailing NUMERIC component equals the step — an ``endswith``
+        match would hand step 2 the ``12`` directory)."""
+        import re
+
+        base = str(self._mgr.directory)
+        p = os.path.join(base, str(step))
+        if os.path.isdir(p):
+            return p
+        for name in sorted(os.listdir(base)):
+            full = os.path.join(base, name)
+            m = re.search(r"(\d+)$", name)
+            if (os.path.isdir(full) and m is not None
+                    and int(m.group(1)) == step):
+                return full
+        return p
 
     def restore(self, step: int, template: Any) -> Any:
         import jax

@@ -742,7 +742,8 @@ class TestCacheRoundTrip:
         path = tune.cache_path()
         with open(path) as f:
             data = json.load(f)
-        assert data["version"] == 1
+        from mpi4torch_tpu.tune.autotuner import CACHE_VERSION
+        assert data["version"] == CACHE_VERSION
         assert any(v["algorithm"] == "rhd" for v in data["entries"].values())
         # fresh in-process table: the entry comes back from disk
         tune.clear()
@@ -1445,3 +1446,72 @@ class TestCodecAlgorithmCensus:
         assert "winner" in q8_ent
         assert tune.lookup_algorithm("allreduce", jnp.float32, 1 << 12, 4,
                                      codec="q8") == q8_ent["winner"]
+
+
+class TestMeasurementRobustness:
+    """ISSUE 7 satellite: per-size measurement is min-of-k, so a single
+    preempted/slow sample cannot poison a persisted cache winner."""
+
+    def test_time_step_is_outlier_immune(self):
+        # 3 of the 5 timed samples are hit by a simulated preemption
+        # pause — the OLD median-of-k would report >= the pause; the
+        # min-of-k estimate must stay at the true (fast) step cost.
+        import time as _time
+
+        from mpi4torch_tpu.tune import autotuner as at
+
+        calls = {"n": 0}
+
+        def step(x):
+            calls["n"] += 1
+            # calls 1-2 are warmup; timed samples are calls 3..7 — hit
+            # the 2nd, 3rd and 4th timed samples (median territory).
+            if calls["n"] in (4, 5, 6):
+                _time.sleep(0.12)
+            return (x,)
+
+        dt = at._time_step(step, jnp.ones((8,), jnp.float32), iters=5)
+        assert dt < 0.06, (
+            f"min-of-k must shrug off one-sided outliers, got {dt}")
+
+    def test_outlier_cannot_flip_a_winner(self):
+        # The decision-level regression: with the measurement rule
+        # applied to two candidates' raw sample sets, a preemption hit
+        # on the TRUE winner must not hand the cache key to the loser.
+        # (Median-of-5 flips here: 3 of ring's 5 samples are hit.)
+        from mpi4torch_tpu.tune import autotuner as at
+
+        ring_samples = [0.001, 0.50, 0.48, 0.52, 0.001]   # true 1ms
+        tree_samples = [0.002] * 5                        # true 2ms
+
+        def measure(samples):
+            # Drive _time_step's clock: each timed step() call advances
+            # a fake perf_counter by its scripted duration (warmups: 0).
+            import time as _time
+
+            real = _time.perf_counter
+            acc = {"t": 0.0}
+            calls = {"n": 0}
+
+            def step(x):
+                calls["n"] += 1
+                if calls["n"] > 2:   # calls 1-2 are warmup
+                    acc["t"] += samples[calls["n"] - 3]
+                return (x,)
+
+            _time.perf_counter = lambda: acc["t"]
+            try:
+                return at._time_step(step, jnp.ones((4,), jnp.float32),
+                                     iters=len(samples))
+            finally:
+                _time.perf_counter = real
+
+        assert measure(ring_samples) < measure(tree_samples), (
+            "the outlier-hit true winner must still measure fastest")
+
+    def test_cache_version_keys_in_the_min_rule(self):
+        # Winners measured under the old median rule must be discarded:
+        # the measurement-rule change rides the cache version.
+        from mpi4torch_tpu.tune import autotuner as at
+
+        assert at.CACHE_VERSION >= 2
