@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -75,6 +75,20 @@ faults-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.resilience --smoke
+
+# CPU smoke run of the resharding subsystem (mpi4torch_tpu.reshard):
+# every representative (mesh, spec)->(mesh', spec') transition — the
+# (8,)->(2,4)/(4,2) migrations, axis moves, coarsen/refine, block
+# permutes, the ZeRO->TP handoff shape, plus a forced permute-rounds
+# cell — checked BITWISE against the gather-then-slice oracle on the
+# 8-virtual-device mesh, each planned lowering's censused peak live
+# bytes strictly below the gather baseline's, a deterministic-mode leg,
+# a VJP leg (cotangents redistribute spec'->spec), and the step-kind
+# registry-sync guard.  Exits non-zero on any divergence.
+reshard-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.reshard --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -26,7 +26,7 @@ DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism",
          "compression", "fusion", "algorithms", "overlap", "resilience",
-         "api_reference", "design_tpu", "glossary"]
+         "reshard", "api_reference", "design_tpu", "glossary"]
 
 CSS = """
 body { font-family: -apple-system, "Segoe UI", Roboto, sans-serif;

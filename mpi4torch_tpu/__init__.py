@@ -68,6 +68,7 @@ from . import fuse
 from . import tune
 from . import overlap
 from . import resilience
+from . import reshard
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
@@ -115,6 +116,7 @@ __all__ = [
     "tune",
     "overlap",
     "resilience",
+    "reshard",
     "SpmdWaitHandle",
     "FaultPlan",
     "FaultSpec",

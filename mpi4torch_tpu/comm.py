@@ -414,6 +414,37 @@ class MPI_Communicator:
                 bucket_bytes=bucket_bytes, mean=mean, overlap=overlap,
                 algorithm=algorithm)
 
+    def Reshard(self, tree, from_spec, to_spec, strategy=None,
+                compression=None):
+        """Redistribute a pytree of shards from one sharding layout to
+        another (:mod:`mpi4torch_tpu.reshard`): each leaf moves from its
+        ``from_spec`` :class:`~mpi4torch_tpu.reshard.Layout` to its
+        ``to_spec`` Layout through a planned program of portable
+        collectives whose peak live bytes stay ``O(shard + chunk)`` —
+        never the gather-everything default.  ``from_spec``/``to_spec``
+        are one Layout (broadcast over the tree) or a matching pytree of
+        Layouts (build one from regex rules with
+        :func:`mpi4torch_tpu.reshard.match_partition_rules`).
+
+        AD-transparent with the adjoint-is-the-reverse-plan contract:
+        under ``jax.grad`` the cotangents redistribute ``to_spec`` ->
+        ``from_spec``.  Identical bits on both backends (every planned
+        step is pure data movement; the adjoint's reduction folds in the
+        eager oracle's order under ``deterministic_mode``).
+
+        ``strategy`` pins a planner strategy
+        (:data:`mpi4torch_tpu.reshard.STRATEGIES`; ``None`` = the
+        :func:`config.default_reshard_strategy` / auto preference order
+        with the transition-keyed autotuner winner).  ``compression``
+        (explicit only — state migration never inherits the gradient
+        codec scope) rides the wide full-world gather hop of the
+        ``gather`` baseline strategy."""
+        from .reshard import reshard_tree
+        with jax.named_scope("mpi4torch.Reshard"):
+            return reshard_tree(self, tree, from_spec, to_spec,
+                                strategy=strategy,
+                                compression=compression)
+
     # ------------------------------------------- split-phase collectives
 
     def Allreduce_start(self, tensor, op: int, compression=None,

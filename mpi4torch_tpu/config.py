@@ -484,6 +484,37 @@ def set_quant_hop_impl(impl: str) -> None:
     _quant_hop_impl = impl
 
 
+# Default planning strategy of the resharding subsystem
+# (mpi4torch_tpu.reshard): "auto" lets the planner walk its preference
+# order (local < permute < allgather < alltoall < rounds — gather, the
+# full-materialization baseline, only ever wins through a measured tune
+# cache entry); a concrete name pins every plan to that strategy and
+# raises where it cannot serve the transition.  Part of the trace-time
+# fingerprint: run_spmd retraces when it changes.
+_reshard_strategy = None
+
+
+def default_reshard_strategy():
+    """The plan strategy :func:`mpi4torch_tpu.reshard.plan_reshard`
+    uses when no explicit ``strategy=`` is passed (``None``/``"auto"``
+    = preference order + transition-keyed autotuner winner)."""
+    return _reshard_strategy
+
+
+def set_default_reshard_strategy(name) -> None:
+    global _reshard_strategy
+    if name in (None, "auto"):
+        _reshard_strategy = None
+        return
+    from .reshard.plan import STRATEGIES
+
+    if name not in STRATEGIES:
+        raise ValueError(
+            f"reshard strategy must be one of {STRATEGIES} or "
+            f"None/'auto', got {name!r}")
+    _reshard_strategy = name
+
+
 # Intra-group size of the 2-level `hier` allreduce on a single mesh axis.
 # None = derive: the minor axis extent when the communicator was adopted
 # from a multi-axis mesh, else the divisor of nranks closest to sqrt.
@@ -638,7 +669,7 @@ def thresholds_fingerprint():
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
             _hier_group_size, _chain_unroll_max, _quant_hop_impl,
-            _comm_finite_guard)
+            _comm_finite_guard, _reshard_strategy)
 
 
 @contextmanager

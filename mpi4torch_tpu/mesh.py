@@ -30,7 +30,40 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["device_mesh", "hybrid_mesh"]
+__all__ = ["device_mesh", "hybrid_mesh", "mesh_coords", "rank_of_coords"]
+
+
+def mesh_coords(rank: int, mesh_shape: Sequence[int]) -> tuple:
+    """Row-major mesh coordinates of a flat rank — THE rank <-> coords
+    convention of the whole framework (the torus schedules' virtual 2D
+    factorization and the :mod:`mpi4torch_tpu.reshard` layouts both key
+    off it): the LAST mesh axis varies fastest, matching
+    :func:`device_mesh`'s axis-significance order."""
+    rank = int(rank)
+    total = math.prod(mesh_shape)
+    if not (0 <= rank < total):
+        raise ValueError(f"rank {rank} out of range for mesh "
+                         f"{tuple(mesh_shape)} ({total} ranks)")
+    coords = []
+    for m in reversed(tuple(mesh_shape)):
+        coords.append(rank % m)
+        rank //= m
+    return tuple(reversed(coords))
+
+
+def rank_of_coords(coords: Sequence[int], mesh_shape: Sequence[int]) -> int:
+    """Inverse of :func:`mesh_coords`: the flat rank of row-major mesh
+    coordinates."""
+    coords, mesh_shape = tuple(coords), tuple(mesh_shape)
+    if len(coords) != len(mesh_shape):
+        raise ValueError(
+            f"coords {coords} do not match mesh {mesh_shape}")
+    r = 0
+    for c, m in zip(coords, mesh_shape):
+        if not (0 <= int(c) < m):
+            raise ValueError(f"coords {coords} out of mesh {mesh_shape}")
+        r = r * m + int(c)
+    return r
 
 
 def _check_sizes(shape: Mapping[str, int], n: int, what: str) -> None:

@@ -33,9 +33,11 @@ from .tp import (
     tp_attention,
     tp_mlp,
 )
-from .moe import init_moe, moe_ffn, moe_ffn_dense, top1_route
+from .moe import (balanced_assignment, init_moe, moe_ffn,
+                  moe_ffn_dense, rebalance_experts, top1_route)
 from .zero import (shard_global_norm, zero3_init, zero3_params,
-                   zero3_shard_params, zero3_step, zero_init, zero_step)
+                   zero3_shard_params, zero3_step, zero3_to_tp,
+                   zero_init, zero_step)
 from .pp import (pipeline_spmd, pipeline_step, pipeline_step_1f1b,
                  pipeline_step_interleaved,
                  recv_activation, schedule_1f1b, send_activation)
@@ -49,6 +51,7 @@ __all__ = [
     "zero3_params",
     "zero3_shard_params",
     "zero3_step",
+    "zero3_to_tp",
     "attention",
     "dp",
     "moe",
@@ -72,6 +75,8 @@ __all__ = [
     "init_moe",
     "moe_ffn",
     "moe_ffn_dense",
+    "balanced_assignment",
+    "rebalance_experts",
     "top1_route",
     "pipeline_spmd",
     "pipeline_step",

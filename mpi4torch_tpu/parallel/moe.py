@@ -137,6 +137,56 @@ def moe_ffn(comm, x, params: Dict[str, Any], capacity: int,
     return y, aux
 
 
+def balanced_assignment(loads, size: int):
+    """A load-balancing expert assignment with equal per-rank counts:
+    experts sorted by observed load descending, dealt to the ranks in
+    snake order (forward, then backward, ...), so each rank gets
+    ``E/size`` experts and the per-rank load totals stay within one
+    expert of each other.  Returns the permutation ``perm`` consumed by
+    :func:`rebalance_experts`: new global slot ``u`` (rank-major,
+    ``u // epr`` = owner) holds old expert ``perm[u]``."""
+    loads = [float(x) for x in jnp.asarray(loads).reshape(-1)]
+    E = len(loads)
+    if E % size:
+        raise ValueError(
+            f"n_experts ({E}) not divisible by world size ({size})")
+    epr = E // size
+    order = sorted(range(E), key=lambda e: -loads[e])
+    slots = [[] for _ in range(size)]
+    it = iter(order)
+    for k in range(epr):
+        ranks = range(size) if k % 2 == 0 else range(size - 1, -1, -1)
+        for r in ranks:
+            slots[r].append(next(it))
+    return tuple(e for r in range(size) for e in slots[r])
+
+
+def rebalance_experts(comm, experts, assignment, strategy=None):
+    """Expert rebalancing as a planned redistribution
+    (:mod:`mpi4torch_tpu.reshard`): ``experts`` is a pytree of
+    expert-stacked arrays whose axis 0 holds this rank's LOCAL experts
+    (``epr`` per rank, rank-major — the persistent EP sharding), and
+    ``assignment`` is a permutation of the ``E`` global experts (e.g.
+    from :func:`balanced_assignment`): new global slot ``u`` receives
+    old expert ``assignment[u]``.
+
+    Every leaf rides one block-permutation plan — a single
+    ``collective_permute`` round per moving expert in flight, never a
+    full gather — and the move is differentiable: cotangents ride the
+    inverse permutation back to the old owners."""
+    from .. import reshard as _rs
+
+    size = comm.size
+    assignment = tuple(int(a) for a in assignment)
+
+    def one(x):
+        lay = _rs.Layout((size,), ((0,),) + ((),) * (jnp.ndim(x) - 1))
+        return _rs.reshard_blocks(comm, x, lay, 0, assignment,
+                                  strategy=strategy)
+
+    return jax.tree.map(one, experts)
+
+
 def moe_ffn_dense(x, params: Dict[str, Any], capacity: int,
                   activation=jax.nn.gelu):
     """Single-device oracle: identical routing/capacity semantics, all

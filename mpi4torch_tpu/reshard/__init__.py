@@ -1,0 +1,55 @@
+"""mpi4torch_tpu.reshard — AD-transparent sharding -> sharding
+redistribution with memory-bounded portable-collective plans.
+
+The transitions production actually hits — train on ``(8,)``, serve on
+``(2,4)``; ZeRO-shard -> TP-shard at the train/serve boundary; MoE
+expert rebalancing; topology-migrating checkpoint restore — become one
+differentiable facade call::
+
+    y = comm.Reshard(tree, from_spec, to_spec)
+
+following "Memory-efficient array redistribution through portable
+collective communication" (PAPERS.md, arXiv 2112.01075): the planner
+(:mod:`.plan`) decomposes any (mesh, spec) -> (mesh', spec') pair into a
+short program of portable steps — all-gather / all-to-all /
+collective-permute / dynamic-slice — whose peak live bytes stay
+``O(shard + chunk)`` instead of the gather-everything baseline's
+``O(full array)``; the executor (:mod:`.executor`) lowers the same plan
+to native collectives under SPMD and replays it through the rendezvous
+on the eager thread world (bitwise-identical, fault-grammar-covered);
+the VJP executes the *reverse* plan, so cotangents redistribute
+spec' -> spec.  ``python -m mpi4torch_tpu.reshard --smoke`` sweeps the
+representative transitions against the gather-then-slice oracle (`make
+reshard-smoke`).
+"""
+
+from .census import peak_live_bytes, tensor_bytes
+from .executor import (execute_plan, gather_then_slice, global_template,
+                       reshard_blocks, reshard_tree, reshard_value,
+                       shard_of, shard_template, slice_shard)
+from .plan import (STEP_KINDS, STRATEGIES, Layout, ReshardPlan, layout,
+                   plan_permutation, plan_reshard)
+from .rules import match_partition_rules, tree_paths
+
+__all__ = [
+    "Layout",
+    "layout",
+    "ReshardPlan",
+    "STEP_KINDS",
+    "STRATEGIES",
+    "plan_reshard",
+    "plan_permutation",
+    "execute_plan",
+    "reshard_value",
+    "reshard_tree",
+    "reshard_blocks",
+    "gather_then_slice",
+    "slice_shard",
+    "shard_of",
+    "shard_template",
+    "global_template",
+    "match_partition_rules",
+    "tree_paths",
+    "peak_live_bytes",
+    "tensor_bytes",
+]

@@ -1,0 +1,542 @@
+"""The reshard executor: one plan, two backends, bit-identical results.
+
+Mode A (SPMD mesh) lowers each plan step to the native collective —
+``collective_permute`` for permute rounds, grouped ``all_to_all`` /
+``all_gather`` / ``psum_scatter`` for the exchange and coarsening steps,
+``dynamic_slice``/``dynamic_update_slice`` with per-rank constant tables
+for the local moves.  Mode B (eager thread world) replays the SAME plan
+through the rendezvous (``World.exchange``), which buys two things for
+free: bitwise cross-mode parity (every step is pure data movement; the
+one reduction — the all-gather adjoint — folds in ascending group order
+under ``deterministic_mode``, the eager oracle's association), and the
+:mod:`mpi4torch_tpu.resilience` fault grammar (the rendezvous and p2p
+mailboxes are the chokepoints every injected fault rides).
+
+The facade entry (:func:`reshard_value` / :func:`reshard_tree`, surfaced
+as ``comm.Reshard``) wraps the whole plan in ONE ``jax.custom_vjp``
+whose backward executes :meth:`ReshardPlan.adjoint` — the reverse plan —
+on the cotangents: spec' -> spec redistribution, the
+adjoint-is-itself-a-collective contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import config as _config
+from ..runtime import CommError
+from .plan import (Layout, ReshardPlan, _MOVE_KINDS, plan_permutation,
+                   plan_reshard)
+
+__all__ = [
+    "execute_plan", "reshard_value", "reshard_tree", "gather_then_slice",
+    "slice_shard", "shard_of", "shard_template", "global_template",
+]
+
+
+def as_layout(spec) -> Layout:
+    if isinstance(spec, Layout):
+        return spec
+    if isinstance(spec, (tuple, list)) and len(spec) == 2:
+        return Layout(tuple(spec[0]), tuple(spec[1]))
+    raise CommError(
+        f"expected a reshard Layout (or a (mesh, spec) pair); got "
+        f"{spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Shared pipeline driver
+# ---------------------------------------------------------------------------
+
+
+def _run(plan: ReshardPlan, x, move_fn, transform_fn):
+    """Thread the value through the step program: move steps
+    (slice/pad/permute/alltoall) fill a zeros output buffer; transform
+    steps (allgather/reduce_scatter) map value -> value; a transform
+    following a move phase consumes that phase's buffer."""
+    if tuple(x.shape) != plan.in_shape:
+        raise CommError(
+            f"Reshard input shard has shape {tuple(x.shape)}, but the "
+            f"plan for {plan.transition} expects {plan.in_shape}")
+    v, out = x, None
+    for i, st in enumerate(plan.steps):
+        with jax.named_scope(f"mpi4torch.Reshard.{st.kind}"):
+            if st.kind in _MOVE_KINDS:
+                if out is None:
+                    out = jnp.zeros(st.out_shape, x.dtype)
+                out = move_fn(i, st, v, out)
+            else:
+                if out is not None:
+                    v, out = out, None
+                v = transform_fn(i, st, v)
+    return out if out is not None else v
+
+
+def _dslice(buf, starts, shape):
+    return lax.dynamic_slice(buf, tuple(starts), shape)
+
+
+def _dput(buf, starts, val):
+    return lax.dynamic_update_slice(buf, val, tuple(starts))
+
+
+def _place(out, starts, val, valid, accumulate, chunk):
+    cur = _dslice(out, starts, chunk)
+    if accumulate:
+        new = cur + jnp.where(valid, val, jnp.zeros_like(val))
+    else:
+        new = jnp.where(valid, val, cur)
+    return _dput(out, starts, new)
+
+
+# ---------------------------------------------------------------------------
+# Mode A: SPMD lowering
+# ---------------------------------------------------------------------------
+
+
+def _rank_row(ctx, table):
+    """This rank's row of a static per-rank table, as traced values."""
+    idx = lax.axis_index(ctx.axis_name)
+    return jnp.asarray(np.asarray(table))[idx]
+
+
+def _spmd_local(ctx, st, v, out):
+    valids = tuple(tuple(m[0] for m in per) for per in st.moves)
+    srcs = tuple(tuple(m[1] for m in per) for per in st.moves)
+    dsts = tuple(tuple(m[2] for m in per) for per in st.moves)
+    nmoves = len(st.moves[0])
+    vtab = _rank_row(ctx, valids)
+    stab = _rank_row(ctx, srcs)
+    dtab = _rank_row(ctx, dsts)
+    accumulate = st.kind == "pad"
+    for m in range(nmoves):
+        chunk = _dslice(v, [stab[m, i] for i in range(len(st.src_chunk))],
+                        st.src_chunk)
+        chunk = chunk.reshape(st.dst_chunk)
+        out = _place(out, [dtab[m, i] for i in range(len(st.dst_chunk))],
+                     chunk, vtab[m], accumulate, st.dst_chunk)
+    return out
+
+
+def _spmd_permute(ctx, st, v, out):
+    nd = len(st.chunk)
+    sv = _rank_row(ctx, tuple(bool(s[0]) for s in st.send))
+    ss = _rank_row(ctx, tuple(s[1] for s in st.send))
+    rv = _rank_row(ctx, tuple(bool(r[0]) for r in st.recv))
+    rs = _rank_row(ctx, tuple(r[1] for r in st.recv))
+    buf = _dslice(v, [ss[i] for i in range(nd)], st.chunk)
+    buf = jnp.where(sv, buf, jnp.zeros_like(buf))
+    n = len(st.table)
+    pairs = [(i, st.table[i]) for i in range(n) if st.table[i] != i]
+    if pairs:
+        got = lax.ppermute(buf, ctx.axis_name, perm=pairs)
+        selfs = tuple(st.table[i] == i for i in range(n))
+        if any(selfs):
+            # Self-pairs are local hand-offs (the emitted permute only
+            # carries the real moves); those ranks keep their own chunk.
+            got = jnp.where(_rank_row(ctx, selfs), buf, got)
+    else:
+        got = buf
+    return _place(out, [rs[i] for i in range(nd)], got, rv,
+                  st.accumulate, st.chunk)
+
+
+def _spmd_alltoall(ctx, st, v, out):
+    nd = len(st.chunk)
+    slots = len(st.send[0])
+    stab = _rank_row(ctx, st.send)       # (slots, nd)
+    rtab = _rank_row(ctx, st.recv)
+    pieces = [
+        _dslice(v, [stab[t, i] for i in range(nd)], st.chunk)
+        for t in range(slots)]
+    buf = jnp.stack(pieces)
+    got = lax.all_to_all(buf, ctx.axis_name, split_axis=0, concat_axis=0,
+                         axis_index_groups=[list(g) for g in st.groups],
+                         tiled=True)
+    true = jnp.asarray(True)
+    for t in range(slots):
+        out = _place(out, [rtab[t, i] for i in range(nd)], got[t], true,
+                     st.accumulate, st.chunk)
+    return out
+
+
+def _spmd_allgather(ctx, st, v, codec=None):
+    if st.axis is None:
+        if codec is not None:
+            from ..compress import spmd as _cspmd
+
+            return _cspmd.allgather(ctx, v[None], 0, codec)
+        return lax.all_gather(v, ctx.axis_name, axis=0, tiled=False)
+    return lax.all_gather(v, ctx.axis_name, axis=st.axis, tiled=True,
+                          axis_index_groups=[list(g) for g in st.groups])
+
+
+def _group_pos(groups, size):
+    pos = [0] * size
+    for g in groups:
+        for p, r in enumerate(g):
+            pos[r] = p
+    return tuple(pos)
+
+
+def _spmd_reduce_scatter(ctx, st, v):
+    if st.axis is None:
+        # Stack form: input (N, *shard); each rank keeps the rank-sum's
+        # row at its own index.
+        n = ctx.size
+        if _config.deterministic_reductions():
+            stacked = lax.all_gather(v, ctx.axis_name, axis=0, tiled=False)
+            acc = stacked[0]
+            for i in range(1, n):
+                acc = acc + stacked[i]
+            idx = lax.axis_index(ctx.axis_name)
+            return lax.dynamic_index_in_dim(acc, idx, 0, keepdims=False)
+        flat = v.reshape(n, -1)
+        part = lax.psum_scatter(flat, ctx.axis_name, scatter_dimension=0,
+                                tiled=True)
+        return part.reshape(st.out_shape)
+    groups = [list(g) for g in st.groups]
+    g = len(groups[0])
+    if _config.deterministic_reductions():
+        stacked = lax.all_gather(v, ctx.axis_name, axis=0, tiled=False,
+                                 axis_index_groups=groups)
+        acc = stacked[0]
+        for i in range(1, g):
+            acc = acc + stacked[i]
+        pos = _rank_row(ctx, _group_pos(st.groups, ctx.size))
+        seg = st.out_shape[st.axis]
+        return lax.dynamic_slice_in_dim(acc, pos * seg, seg, st.axis)
+    return lax.psum_scatter(v, ctx.axis_name, scatter_dimension=st.axis,
+                            axis_index_groups=groups, tiled=True)
+
+
+_SPMD_EXEC = {
+    "slice": _spmd_local,
+    "pad": _spmd_local,
+    "permute": _spmd_permute,
+    "alltoall": _spmd_alltoall,
+    "allgather": _spmd_allgather,
+    "reduce_scatter": _spmd_reduce_scatter,
+}
+
+
+def _exec_spmd(ctx, plan: ReshardPlan, x, codec=None):
+    def move(i, st, v, out):
+        return _SPMD_EXEC[st.kind](ctx, st, v, out)
+
+    def transform(i, st, v):
+        if st.kind == "allgather":
+            return _spmd_allgather(ctx, st, v, codec)
+        return _SPMD_EXEC[st.kind](ctx, st, v)
+
+    return _run(plan, jnp.asarray(x), move, transform)
+
+
+# ---------------------------------------------------------------------------
+# Mode B: rendezvous replay
+# ---------------------------------------------------------------------------
+
+
+def _npslice(buf, starts, shape):
+    return buf[tuple(slice(int(s), int(s) + c)
+                     for s, c in zip(starts, shape))]
+
+
+def _npput(buf, starts, val, accumulate):
+    idx = tuple(slice(int(s), int(s) + c)
+                for s, c in zip(starts, val.shape))
+    return buf.at[idx].add(val) if accumulate else buf.at[idx].set(val)
+
+
+def _esig(st, i, v):
+    return (f"Reshard.{st.kind}", i, tuple(v.shape),
+            str(jnp.asarray(v).dtype))
+
+
+def _eager_local(ectx, i, st, v, out):
+    accumulate = st.kind == "pad"
+    for valid, src, dst in st.moves[ectx.rank]:
+        if not valid:
+            continue
+        chunk = _npslice(v, src, st.src_chunk).reshape(st.dst_chunk)
+        out = _npput(out, dst, chunk, accumulate)
+    return out
+
+
+def _eager_permute(ectx, i, st, v, out):
+    world, rank = ectx.world, ectx.rank
+    sv, ss = st.send[rank]
+    buf = (_npslice(v, ss, st.chunk) if sv
+           else jnp.zeros(st.chunk, v.dtype))
+    vals = world.exchange(rank, _esig(st, i, buf), buf)
+    src = st.table.index(rank)
+    rv, rs = st.recv[rank]
+    if rv:
+        out = _npput(out, rs, vals[src], st.accumulate)
+    return out
+
+
+def _eager_alltoall(ectx, i, st, v, out):
+    world, rank = ectx.world, ectx.rank
+    buf = jnp.stack([_npslice(v, s, st.chunk) for s in st.send[rank]])
+    vals = world.exchange(rank, _esig(st, i, buf), buf)
+    grp = next(g for g in st.groups if rank in g)
+    pos = grp.index(rank)
+    for t, dst in enumerate(st.recv[rank]):
+        p, k = divmod(t, st.cpr)
+        piece = vals[grp[p]][pos * st.cpr + k]
+        out = _npput(out, dst, piece, st.accumulate)
+    return out
+
+
+def _eager_allgather(ectx, i, st, v, codec=None):
+    world, rank = ectx.world, ectx.rank
+    if st.axis is None and codec is not None:
+        from ..compress import eager as _ceager
+
+        return _ceager.allgather(ectx, v[None], 0, codec)
+    vals = world.exchange(rank, _esig(st, i, v), v)
+    if st.axis is None:
+        return jnp.stack(vals)
+    grp = next(g for g in st.groups if rank in g)
+    return jnp.concatenate([vals[m] for m in grp], axis=st.axis)
+
+
+def _eager_reduce_scatter(ectx, i, st, v):
+    world, rank = ectx.world, ectx.rank
+    vals = world.exchange(rank, _esig(st, i, v), v)
+    if st.axis is None:
+        acc = vals[0]
+        for w in vals[1:]:
+            acc = acc + w
+        return acc[rank]
+    grp = next(g for g in st.groups if rank in g)
+    acc = vals[grp[0]]
+    for m in grp[1:]:
+        acc = acc + vals[m]
+    pos = grp.index(rank)
+    seg = st.out_shape[st.axis]
+    sl = [slice(None)] * acc.ndim
+    sl[st.axis] = slice(pos * seg, (pos + 1) * seg)
+    return acc[tuple(sl)]
+
+
+_EAGER_EXEC = {
+    "slice": _eager_local,
+    "pad": _eager_local,
+    "permute": _eager_permute,
+    "alltoall": _eager_alltoall,
+    "allgather": _eager_allgather,
+    "reduce_scatter": _eager_reduce_scatter,
+}
+
+
+def _exec_eager(ectx, plan: ReshardPlan, x, codec=None):
+    from ..ops.eager import _check_concrete
+
+    x = jnp.asarray(x)
+    _check_concrete(x)
+
+    def move(i, st, v, out):
+        return _EAGER_EXEC[st.kind](ectx, i, st, v, out)
+
+    def transform(i, st, v):
+        if st.kind == "allgather":
+            return _eager_allgather(ectx, i, st, v, codec)
+        return _EAGER_EXEC[st.kind](ectx, i, st, v)
+
+    return _run(plan, x, move, transform)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch + facade
+# ---------------------------------------------------------------------------
+
+
+def execute_plan(comm, plan: ReshardPlan, x, codec=None):
+    """Run a compiled plan on ``comm``'s backend (no AD wrapper — use
+    :func:`reshard_value` for the differentiable form)."""
+    from ..comm import _EagerBackend
+    from ..ops.spmd import HierMeshBackend, SpmdBackend
+
+    backend = comm._backend()
+    if isinstance(backend, HierMeshBackend):
+        raise CommError(
+            "Reshard needs a flat communicator (the virtual mesh lives "
+            "in the Layouts); use comm_from_mesh with ONE axis name or "
+            "COMM_WORLD")
+    size = backend.size
+    if size != plan.size:
+        raise CommError(
+            f"plan for {plan.transition} spans {plan.size} ranks, but "
+            f"this communicator has {size}")
+    if isinstance(backend, SpmdBackend):
+        return _exec_spmd(backend._ctx, plan, x, codec)
+    if isinstance(backend, _EagerBackend):
+        return _exec_eager(backend._ctx, plan, x, codec)
+    raise CommError(
+        "Reshard needs the eager thread world (run_ranks) or an SPMD "
+        "mesh communicator; this backend supports neither")
+
+
+def _resolve_reshard_codec(compression, dtype, plan):
+    """Reshard transports state, not gradients: scope/process codec
+    defaults are deliberately ignored (a lossy migration must be
+    explicitly requested).  An explicit codec needs a floating dtype and
+    a wide hop (a full-world gather step) to ride."""
+    if compression is None or compression is False or \
+            compression == "none":
+        return None
+    from ..compress import codec_applicable, get_codec
+
+    codec = get_codec(compression)
+    if codec is None:
+        return None
+    if not codec_applicable(codec, dtype):
+        raise ValueError(
+            f"compression={codec.name!r} requires a floating tensor; "
+            f"got dtype {dtype}")
+    wide = any(st.kind == "allgather" and st.axis is None
+               for st in plan.steps)
+    if not wide:
+        raise ValueError(
+            f"compression={codec.name!r} rides the wide full-world "
+            f"gather hop, and the {plan.strategy!r} plan for "
+            f"{plan.transition} has none — drop compression= (the "
+            "planned exchange already moves O(shard) bytes) or pin "
+            "strategy='gather'")
+    return codec
+
+
+def _apply_plan_vjp(comm, plan: ReshardPlan, x, codec):
+    @jax.custom_vjp
+    def f(v):
+        return execute_plan(comm, plan, v, codec)
+
+    def bwd(_, g):
+        # The reverse plan: cotangents redistribute spec' -> spec.  The
+        # adjoint is exact even when the forward hop was compressed
+        # (compression is an opt-in forward transport, not a gradient
+        # codec here).
+        with jax.named_scope("mpi4torch.ReshardBackward"):
+            return (execute_plan(comm, plan.adjoint(), g, None),)
+
+    f.defvjp(lambda v: (execute_plan(comm, plan, v, codec), None), bwd)
+    return f(x)
+
+
+def reshard_value(comm, x, from_spec, to_spec, strategy=None,
+                  compression=None):
+    """Redistribute one array shard from ``from_spec`` to ``to_spec``
+    (both :class:`Layout`); differentiable, the VJP being the reverse
+    plan."""
+    x = jnp.asarray(x)
+    fl, tl = as_layout(from_spec), as_layout(to_spec)
+    gshape = fl.global_shape(x.shape)
+    plan = plan_reshard(fl, tl, gshape, x.dtype, strategy)
+    codec = _resolve_reshard_codec(compression, x.dtype, plan)
+    return _apply_plan_vjp(comm, plan, x, codec)
+
+
+def _spec_tree(spec, tree):
+    """Broadcast a single Layout over the tree, or validate a matching
+    Layout pytree (Layout is not a registered pytree node, so Layouts
+    are leaves)."""
+    if isinstance(spec, Layout):
+        return jax.tree.map(lambda _: spec, tree)
+    lays = jax.tree.map(as_layout, spec)
+    if jax.tree.structure(lays) != jax.tree.structure(tree):
+        raise CommError(
+            "from_spec/to_spec must be one Layout or a pytree of "
+            f"Layouts matching the state tree; got structure "
+            f"{jax.tree.structure(lays)} vs {jax.tree.structure(tree)}")
+    return lays
+
+
+def reshard_tree(comm, tree, from_spec, to_spec, strategy=None,
+                 compression=None):
+    """The pytree form behind ``comm.Reshard``: per-leaf layouts (one
+    Layout broadcast over the tree, or a matching pytree of Layouts —
+    build one from regex rules with :func:`mpi4torch_tpu.reshard.
+    match_partition_rules`)."""
+    fls = _spec_tree(from_spec, tree)
+    tls = _spec_tree(to_spec, tree)
+    return jax.tree.map(
+        lambda x, fl, tl: reshard_value(comm, x, fl, tl,
+                                        strategy=strategy,
+                                        compression=compression),
+        tree, fls, tls)
+
+
+def gather_then_slice(comm, x, from_spec, to_spec):
+    """The baseline/oracle transition: gather the full array on every
+    rank, slice the target shard — ``O(full array)`` peak live bytes,
+    which is exactly what the planner exists to avoid.  Every planned
+    transition must be bitwise-equal to this."""
+    return reshard_value(comm, x, from_spec, to_spec, strategy="gather")
+
+
+def reshard_blocks(comm, tree, lay, axis, perm, strategy=None):
+    """Apply a block permutation along ``axis`` (see
+    :func:`mpi4torch_tpu.reshard.plan_permutation`) to every leaf — the
+    MoE expert-rebalancing transport.  Differentiable; the VJP applies
+    the inverse permutation."""
+    lay = as_layout(lay)
+
+    def one(x):
+        x = jnp.asarray(x)
+        plan = plan_permutation(lay, axis, perm, lay.global_shape(x.shape),
+                                x.dtype, strategy)
+        return _apply_plan_vjp(comm, plan, x, None)
+
+    return jax.tree.map(one, tree)
+
+
+# ---------------------------------------------------------------------------
+# Host-side shard helpers (checkpoint migration I/O)
+# ---------------------------------------------------------------------------
+
+
+def slice_shard(arr, lay: Layout, rank: int):
+    """``rank``'s shard of a GLOBAL array under ``lay`` (host-side
+    slicing — the simulation of orbax's native sharded restore on the
+    CPU harness)."""
+    lay = as_layout(lay)
+    shard = lay.shard_shape(np.shape(arr))
+    block = lay.block(int(rank))
+    idx = tuple(slice(b * s, (b + 1) * s) for b, s in zip(block, shard))
+    return jnp.asarray(arr)[idx]
+
+
+def _leaf_dtype(x):
+    return getattr(x, "dtype", None) or jnp.asarray(x).dtype
+
+
+def shard_of(tree, spec, rank: int):
+    """Tree-mapped :func:`slice_shard`."""
+    lays = _spec_tree(spec, tree)
+    return jax.tree.map(lambda x, l: slice_shard(x, l, rank), tree, lays)
+
+
+def shard_template(tree, spec):
+    """ShapeDtypeStruct tree of the per-rank shards of a global-shaped
+    template under ``spec`` (rank-independent: every shard has the same
+    shape)."""
+    lays = _spec_tree(spec, tree)
+    return jax.tree.map(
+        lambda x, l: jax.ShapeDtypeStruct(l.shard_shape(np.shape(x)),
+                                          _leaf_dtype(x)),
+        tree, lays)
+
+
+def global_template(tree, spec):
+    """ShapeDtypeStruct tree of the GLOBAL arrays whose shards a
+    shard-shaped template describes under ``spec``."""
+    lays = _spec_tree(spec, tree)
+    return jax.tree.map(
+        lambda x, l: jax.ShapeDtypeStruct(l.global_shape(np.shape(x)),
+                                          _leaf_dtype(x)),
+        tree, lays)

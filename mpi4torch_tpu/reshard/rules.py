@@ -1,0 +1,77 @@
+"""Partition rules: regex -> Layout over whole state pytrees.
+
+The facade pattern of SNIPPETS.md [3] (``match_partition_rules``):
+instead of hand-writing a Layout per leaf of a transformer state tree,
+write a short ordered rule list — first regex matching the leaf's
+``/``-joined tree path wins::
+
+    rules = [
+        (r"embed",        reshard.layout((2, 4), (0, 1))),   # rows
+        (r"attn/w_[qkvo]", reshard.layout((2, 4), None, 1)),  # columns
+        (r".*",           reshard.layout((2, 4), None)),      # replicate
+    ]
+    to_specs = reshard.match_partition_rules(rules, params)
+    sharded = comm.Reshard(shards, from_specs, to_specs)
+
+Scalar leaves never partition (the snippet's rule) — they take the
+replicated layout of the first rule's mesh.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..runtime import CommError
+from .plan import Layout
+
+__all__ = ["tree_paths", "match_partition_rules"]
+
+
+def _key_str(k) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def tree_paths(tree, sep: str = "/"):
+    """A pytree of the same structure whose leaves are the
+    ``sep``-joined key paths (``{"a": {"b": [x]}} -> "a/b/0"``)."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree.unflatten(
+        treedef, [sep.join(_key_str(k) for k in path)
+                  for path, _ in paths_leaves])
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, Layout]], tree,
+                          sep: str = "/"):
+    """A Layout pytree for ``tree``: each leaf takes the first rule
+    whose regex ``re.search``-matches its path.  Scalar (0-d or
+    1-element) leaves take the replicated form of the first rule's
+    mesh; a leaf no rule matches raises (a silent default would shard
+    a tensor the author never considered)."""
+    rules = [(p, lay) for p, lay in rules]
+    if not rules:
+        raise CommError("match_partition_rules needs at least one rule")
+    mesh = rules[0][1].mesh
+
+    def pick(path, leaf):
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return Layout(mesh, ((),) * len(shape))
+        for pattern, lay in rules:
+            if re.search(pattern, path) is not None:
+                if lay.ndim != len(shape):
+                    raise CommError(
+                        f"rule {pattern!r} assigns a {lay.ndim}-axis "
+                        f"layout to {path!r} of shape {shape}")
+                return lay
+        raise CommError(f"no partition rule matches leaf {path!r} "
+                        f"(shape {shape}); add a catch-all rule")
+
+    paths = tree_paths(tree, sep)
+    return jax.tree.map(pick, paths, tree)

@@ -26,6 +26,8 @@ import os
 import warnings
 from typing import Any, Optional, Tuple
 
+from ..runtime import CommError
+
 __all__ = ["restore_or_init"]
 
 
@@ -81,6 +83,12 @@ def restore_or_init(directory: str, template: Any, *,
             with CheckpointManager(directory,
                                    max_to_keep=max_to_keep) as mgr:
                 state = mgr.restore(step, template=template)
+        except CommError:
+            # A saved-vs-template layout mismatch (utils.checkpoint's
+            # upfront guard) holds for EVERY step — walking back would
+            # silently discard the whole history and restart from init.
+            # Propagate the typed error pointing at restore_resharded.
+            raise
         except Exception as e:  # noqa: BLE001 — torn step: fall back
             warnings.warn(
                 f"checkpoint step {step} is unusable "

@@ -81,7 +81,8 @@ def _codec_name(codec) -> Optional[str]:
 
 
 def make_key(collective: str, dtype, nbytes: int, nranks: int,
-             platform: Optional[str] = None, codec=None) -> str:
+             platform: Optional[str] = None, codec=None,
+             transition: Optional[str] = None) -> str:
     import numpy as np
 
     if platform is None:
@@ -96,7 +97,29 @@ def make_key(collective: str, dtype, nbytes: int, nranks: int,
     name = _codec_name(codec)
     if name is not None:
         key += "|codec=" + str(name)
+    # The transition dimension (mpi4torch_tpu.reshard): a measured
+    # redistribution winner is specific to its (layout, layout', shape)
+    # transition — the same growth pattern as the codec dimension, so
+    # reshard entries can never collide with collective-algorithm keys.
+    if transition is not None:
+        key += "|transition=" + str(transition)
     return key
+
+
+def _validate_winner(collective: str, algorithm: str) -> None:
+    """Winner names are validated against the registry that owns them:
+    reshard entries name a planner strategy, everything else a
+    collective algorithm.  Raises on unknown names (record) — lookup
+    callers catch and ignore stale entries."""
+    if collective == "reshard":
+        from ..reshard.plan import STRATEGIES
+
+        if algorithm not in STRATEGIES:
+            raise ValueError(
+                f"unknown reshard strategy {algorithm!r}; expected one "
+                f"of {STRATEGIES}")
+        return
+    get_algorithm(algorithm)
 
 
 def _load() -> None:
@@ -206,17 +229,18 @@ def _save() -> None:
 
 
 def lookup(collective: str, dtype, nbytes: int, nranks: int,
-           platform: Optional[str] = None, codec=None) -> Optional[dict]:
+           platform: Optional[str] = None, codec=None,
+           transition: Optional[str] = None) -> Optional[dict]:
     """The cached entry for this key, or None.  Entries naming an
-    algorithm the registry no longer knows (stale cache across
-    versions) are ignored."""
+    algorithm (or reshard strategy) the owning registry no longer knows
+    (stale cache across versions) are ignored."""
     _load()
     ent = _mem.get(make_key(collective, dtype, nbytes, nranks, platform,
-                            codec=codec))
+                            codec=codec, transition=transition))
     if ent is None:
         return None
     try:
-        get_algorithm(ent["algorithm"])
+        _validate_winner(collective, ent["algorithm"])
     except (ValueError, KeyError, TypeError):
         return None
     return ent
@@ -224,8 +248,10 @@ def lookup(collective: str, dtype, nbytes: int, nranks: int,
 
 def lookup_algorithm(collective: str, dtype, nbytes: int, nranks: int,
                      platform: Optional[str] = None,
-                     codec=None) -> Optional[str]:
-    ent = lookup(collective, dtype, nbytes, nranks, platform, codec=codec)
+                     codec=None,
+                     transition: Optional[str] = None) -> Optional[str]:
+    ent = lookup(collective, dtype, nbytes, nranks, platform, codec=codec,
+                 transition=transition)
     return None if ent is None else ent["algorithm"]
 
 
@@ -242,15 +268,16 @@ def entry_from_disk(collective: str, dtype, nbytes: int, nranks: int,
 def record(collective: str, dtype, nbytes: int, nranks: int,
            algorithm: str, platform: Optional[str] = None,
            measurements: Optional[dict] = None,
-           persist: bool = True, codec=None) -> str:
+           persist: bool = True, codec=None,
+           transition: Optional[str] = None) -> str:
     """Store a winner for a key (and persist).  Bumps the selection
     generation so ``run_spmd`` jit cache keys see the change and
     retrace instead of reusing a lowering picked under the old table."""
     global _generation
     _load()
-    get_algorithm(algorithm)  # validate
+    _validate_winner(collective, algorithm)
     key = make_key(collective, dtype, nbytes, nranks, platform,
-                   codec=codec)
+                   codec=codec, transition=transition)
     ent = {"algorithm": algorithm, "measured_at": time.time()}
     name = _codec_name(codec)
     if name is not None:

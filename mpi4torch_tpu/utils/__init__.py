@@ -1,12 +1,14 @@
 """Utilities: eager optimizers, checkpoint/resume, input pipeline,
 test helpers."""
 
-from .checkpoint import CheckpointManager, restore_checkpoint, save_checkpoint
+from .checkpoint import (CheckpointManager, restore_checkpoint,
+                         restore_resharded, save_checkpoint)
 from .data import prefetch_to_device, shard_batches, shard_batches_comm
 from .lbfgs import LBFGS, minimize_lbfgs
 from .profiling import bucket_scope, profiler_trace
 
 __all__ = ["LBFGS", "minimize_lbfgs", "CheckpointManager",
-           "restore_checkpoint", "save_checkpoint", "profiler_trace",
+           "restore_checkpoint", "restore_resharded",
+           "save_checkpoint", "profiler_trace",
            "bucket_scope", "shard_batches", "shard_batches_comm",
            "prefetch_to_device"]

@@ -30,7 +30,74 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "restore_resharded",
+           "CheckpointManager"]
+
+
+def _saved_shapes(path: str):
+    """Best-effort leaf-shape tree of the checkpoint at ``path`` (orbax
+    metadata; None when the layout/version exposes none).  Shapes are
+    stringified so the tree structure stays comparable even when leaf
+    RANKS differ (shape tuples are themselves pytree containers — raw
+    tuples would change the treedef and silently void the check)."""
+    import jax
+
+    for p in (path, os.path.join(path, "default")):
+        # CheckpointManager steps keep the state under <step>/default.
+        try:
+            ckptr = _checkpointer()
+            try:
+                md = ckptr.metadata(p)
+            finally:
+                ckptr.close()
+            if md is None:
+                continue
+            return jax.tree.map(lambda m: str(tuple(m.shape)), md,
+                                is_leaf=lambda n: hasattr(n, "shape"))
+        except Exception:
+            continue
+    return None
+
+
+def _check_layout_match(path: str, template: Any) -> None:
+    """Upfront shape check: restoring onto a template whose leaf shapes
+    disagree with the saved checkpoint used to surface as an opaque
+    orbax shape error deep inside the restore — the topology-migration
+    footgun (train on (8,), restore the shard tree on (2,4)).  Detect it
+    here and name both layouts, pointing at the migration recipe.  Only
+    structurally identical trees are compared (structure drift falls
+    through to orbax's own diagnostics)."""
+    import jax
+    import numpy as np
+
+    from ..runtime import CommError
+
+    saved = _saved_shapes(path)
+    if saved is None:
+        return
+    tmpl = jax.tree.map(
+        lambda x: str(tuple(getattr(x, "shape", np.shape(x)))), template)
+    try:
+        s_leaves, s_def = jax.tree_util.tree_flatten_with_path(saved)
+        t_leaves, t_def = jax.tree_util.tree_flatten_with_path(tmpl)
+    except Exception:
+        return
+    if s_def != t_def:
+        return
+    bad = [(jax.tree_util.keystr(kp), ss, ts)
+           for (kp, ss), (_, ts) in zip(s_leaves, t_leaves) if ss != ts]
+    if bad:
+        detail = "; ".join(f"{k}: saved {ss} vs requested {ts}"
+                           for k, ss, ts in bad[:4])
+        more = f" (+{len(bad) - 4} more)" if len(bad) > 4 else ""
+        raise CommError(
+            f"checkpoint at {path} was saved with different leaf "
+            f"shapes than this template requests — {detail}{more}.  "
+            "A shape mismatch usually means the state was sharded on a "
+            "different mesh/spec when saved: restore onto the new "
+            "topology with utils.checkpoint.restore_resharded (the "
+            "mpi4torch_tpu.reshard migration recipe, doc/reshard.md) "
+            "instead of a raw restore_checkpoint.")
 
 
 def _checkpointer():
@@ -85,12 +152,66 @@ def restore_checkpoint(path: str, template: Any) -> Any:
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint directory at {path}")
+    _check_layout_match(path, template)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
     ckptr = _checkpointer()
     try:
         return ckptr.restore(path, abstract)
     finally:
         ckptr.close()
+
+
+def restore_resharded(path: str, template: Any, target_layout, *,
+                      saved_layout=None, comm=None) -> Any:
+    """Topology-migrating restore: read a checkpoint saved under one
+    mesh/spec, return THIS rank's shard under another
+    (:mod:`mpi4torch_tpu.reshard`).
+
+    ``template`` is the GLOBAL-shaped tree (arrays or
+    ``ShapeDtypeStruct`` leaves — the portable on-disk format);
+    ``target_layout`` is one :class:`~mpi4torch_tpu.reshard.Layout` or a
+    matching pytree of them (regex rules:
+    :func:`~mpi4torch_tpu.reshard.match_partition_rules`).
+
+    With ``saved_layout`` given, each rank restores its *saved-layout*
+    shard and the transition to ``target_layout`` runs on-device as a
+    planned ``comm.Reshard`` — the memory-bounded redistribution (on
+    real multi-host meshes orbax restores the saved shards natively;
+    the CPU harness simulates that by slicing the host restore).
+    Without it, the target shard is sliced directly from the restored
+    tree (the plain single-host migration).
+
+    Host-side by nature: call it from the eager world (``run_ranks``
+    rank bodies, or a single process), never inside a compiled SPMD
+    region."""
+    import jax
+
+    from ..comm import COMM_WORLD
+    from ..runtime import CommError
+
+    from .. import reshard as _rs
+
+    comm = COMM_WORLD if comm is None else comm
+    try:
+        rank = int(comm.rank)
+    except CommError:
+        raise CommError(
+            "restore_resharded is host-side checkpoint I/O; call it "
+            "from the eager world (run_ranks) or a single process, not "
+            "inside a compiled SPMD region") from None
+    import numpy as np
+
+    # numpy zeros rather than ShapeDtypeStructs: the installed orbax
+    # rejects sharding-less structs, and a zeros template costs nothing
+    # beyond the restore's own buffers.
+    full = restore_checkpoint(
+        path, jax.tree.map(
+            lambda x: np.zeros(tuple(getattr(x, "shape", ())), x.dtype),
+            template))
+    if saved_layout is None or comm.size == 1:
+        return _rs.shard_of(full, target_layout, rank)
+    mine = _rs.shard_of(full, saved_layout, rank)
+    return comm.Reshard(mine, saved_layout, target_layout)
 
 
 class CheckpointManager:
@@ -161,6 +282,11 @@ class CheckpointManager:
         import jax
         import orbax.checkpoint as ocp
 
+        # Same upfront layout guard as restore_checkpoint: without it a
+        # mesh-mismatched RESUME surfaces as an opaque orbax error that
+        # restore_or_init would misread as a torn step and walk back
+        # through the entire history.
+        _check_layout_match(self._step_path(step), template)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract))

@@ -127,6 +127,191 @@ class TestManagerResume:
         assert saved == [True, False, True, False]
 
 
+class TestTopologyMigration:
+    """ISSUE 9: checkpoint topology migration (mpi4torch_tpu.reshard).
+
+    Train on (8,), serve on (2,4)/(4,2): the smoke transformer's state
+    is saved once (the portable global on-disk form), each rank of the
+    new world restores its OLD-layout shard and the device-side
+    transition is a planned ``comm.Reshard`` — bitwise equal to the
+    gather-then-slice oracle.  Plus the regression for the opaque-orbax
+    failure: restoring onto mismatched leaf shapes now raises a typed
+    ``CommError`` naming both layouts and pointing at the recipe."""
+
+    N = 8
+
+    @staticmethod
+    def _params():
+        import jax.numpy as jnp
+
+        from mpi4torch_tpu.models import transformer as T
+
+        cfg = T.TransformerConfig(vocab=31, d_model=16, n_heads=8,
+                                  n_layers=2, d_ff=32, max_seq=16)
+        return T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                  dtype=jnp.float64)
+
+    @classmethod
+    def _layouts(cls, tree, mesh):
+        """Per-leaf layouts: 2D mesh splits the first/last axes where
+        divisible, a 1D mesh shards the last divisible axis, everything
+        else (odd vocab rows, scalars) replicates."""
+        from mpi4torch_tpu import reshard as rs
+
+        n = int(np.prod(mesh))
+
+        def pick(x):
+            shape = np.shape(x)
+            if not shape or int(np.prod(shape)) == 1:
+                return rs.Layout(mesh, ((),) * len(shape))
+            if (len(mesh) == 2 and len(shape) >= 2
+                    and shape[0] % mesh[0] == 0
+                    and shape[-1] % mesh[1] == 0):
+                spec = [()] * len(shape)
+                spec[0], spec[-1] = (0,), (1,)
+                return rs.Layout(mesh, tuple(spec))
+            for a in reversed(range(len(shape))):
+                if shape[a] % n == 0:
+                    spec = [()] * len(shape)
+                    spec[a] = tuple(range(len(mesh)))
+                    return rs.Layout(mesh, tuple(spec))
+            return rs.Layout(mesh, ((),) * len(shape))
+
+        return jax.tree.map(pick, tree)
+
+    def test_mismatched_restore_raises_typed_error(self, tmp_path):
+        # Regression: this used to surface as an opaque orbax shape
+        # error deep in the restore; now it is a CommError naming the
+        # saved vs requested shapes and the migration recipe.
+        from mpi4torch_tpu import reshard as rs
+        from mpi4torch_tpu.runtime import CommError
+
+        params = self._params()
+        path = str(tmp_path / "ck")
+        save_checkpoint(path, params)
+        wrong = rs.shard_template(params, self._layouts(params, (8,)))
+        with pytest.raises(CommError,
+                           match="restore_resharded") as ei:
+            restore_checkpoint(path, wrong)
+        assert "saved" in str(ei.value) and "requested" in str(ei.value)
+
+    def test_mismatch_caught_across_leaf_ranks(self, tmp_path):
+        # A ZeRO flat-shard template of a 2D saved leaf differs in RANK,
+        # not just extent — the guard must still fire (shape tuples are
+        # themselves pytree containers; naive tree flattening would see
+        # different treedefs and silently skip the comparison).
+        import jax.numpy as jnp
+
+        from mpi4torch_tpu.runtime import CommError
+
+        path = str(tmp_path / "ck")
+        save_checkpoint(path, {"w": jnp.ones((8, 4))})
+        with pytest.raises(CommError, match="restore_resharded"):
+            restore_checkpoint(path, {"w": jnp.ones((32,))})
+
+    def test_manager_resume_mismatch_raises_not_walks_back(self,
+                                                           tmp_path):
+        # Regression for the resume path: CheckpointManager.restore used
+        # to bypass the layout guard, so restore_or_init misread a
+        # mesh-mismatched resume as a torn step, walked back through the
+        # WHOLE history, and silently restarted from init.  Now the
+        # typed CommError propagates from the newest step.
+        import jax.numpy as jnp
+
+        from mpi4torch_tpu import reshard as rs
+        from mpi4torch_tpu.resilience import restore_or_init
+        from mpi4torch_tpu.runtime import CommError
+
+        workdir = str(tmp_path / "run")
+        state = {"w": jnp.arange(32, dtype=jnp.float64).reshape(8, 4)}
+        with CheckpointManager(workdir) as mgr:
+            for step in range(2):
+                mgr.save(step, state, force=True)
+            mgr.wait_until_finished()
+        wrong = rs.shard_template(
+            state, {"w": rs.layout((8,), 0, None)})
+        with CheckpointManager(workdir) as mgr:
+            with pytest.raises(CommError, match="restore_resharded"):
+                mgr.restore(1, template=wrong)
+        with pytest.raises(CommError, match="restore_resharded"):
+            restore_or_init(workdir, template=wrong)
+        # the matched template still resumes normally
+        got, step = restore_or_init(workdir, template=state)
+        assert step == 1
+        assert_tree_equal(got, state)
+
+    @pytest.mark.parametrize("target_mesh", [(2, 4), (4, 2)])
+    def test_migration_roundtrip_bitwise(self, tmp_path, target_mesh):
+        import jax.numpy as jnp
+
+        import mpi4torch_tpu as mpi
+        from mpi4torch_tpu import reshard as rs
+        from mpi4torch_tpu.utils import restore_resharded
+
+        params = self._params()
+        path = str(tmp_path / "ck")
+        save_checkpoint(path, params)
+        saved_specs = self._layouts(params, (self.N,))
+        target_specs = self._layouts(params, target_mesh)
+
+        def body():
+            c = mpi.COMM_WORLD
+            return restore_resharded(path, params, target_specs,
+                                     saved_layout=saved_specs, comm=c)
+
+        out = mpi.run_ranks(body, self.N)
+        for r in range(self.N):
+            oracle = rs.shard_of(params, target_specs, r)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)), out[r], oracle)
+
+    def test_migration_truncated_save_falls_back(self, tmp_path):
+        # Composes with the PR 7 fault grammar: a truncate_save plan
+        # kills the newest step mid-save; restore_or_init lands on the
+        # last complete step and the device-side Reshard migrates THAT
+        # state — one step of progress lost, never the job, never a
+        # silently mixed-topology restore.
+        import jax.numpy as jnp
+
+        import mpi4torch_tpu as mpi
+        from mpi4torch_tpu import reshard as rs
+        from mpi4torch_tpu.resilience import (FaultSpec, fault_scope,
+                                              restore_or_init)
+
+        def state_at(step):
+            return {"w": jnp.arange(32, dtype=jnp.float64).reshape(8, 4)
+                    * (step + 1),
+                    "step": jnp.asarray(step, jnp.int32)}
+
+        workdir = str(tmp_path / "run")
+        with CheckpointManager(workdir) as mgr:
+            for step in range(2):
+                mgr.save(step, state_at(step), force=True)
+            with fault_scope([FaultSpec("truncate_save")]):
+                mgr.save(2, state_at(2), force=True)
+            mgr.wait_until_finished()
+        with pytest.warns(RuntimeWarning):
+            state, step = restore_or_init(workdir,
+                                          template=state_at(0))
+        assert step == 1
+
+        saved_specs = self._layouts(state, (self.N,))
+        target_specs = self._layouts(state, (2, 4))
+
+        def body():
+            c = mpi.COMM_WORLD
+            mine = rs.shard_of(state, saved_specs, c.rank)
+            return c.Reshard(mine, saved_specs, target_specs)
+
+        out = mpi.run_ranks(body, self.N)
+        for r in range(self.N):
+            oracle = rs.shard_of(state_at(1), target_specs, r)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)), out[r], oracle)
+
+
 class TestCorruptionRecovery:
     """ISSUE 7: checkpoint corruption round-trips — a torn (truncated)
     save, a garbage step directory, or an empty workdir must cost at

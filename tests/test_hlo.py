@@ -219,6 +219,19 @@ class TestAdjointCensus:
         assert got == only(
             collective_permute=math.ceil(math.log2(NR)), all_reduce=1)
 
+    def test_alltoall_fwd_bwd_is_two_all_to_all(self):
+        # ISSUE 9 satellite: Alltoall was the one facade collective with
+        # no adjoint census — the reshard executor leans on it, so pin
+        # it: the backward is the axes-swapped all-to-all, exactly one
+        # more stablehlo.all_to_all (value_and_grad keeps the forward
+        # live, as in the Reduce_scatter census above).
+        got = census(
+            lambda c, x: jax.value_and_grad(lambda v: jnp.sum(
+                c.Alltoall(v, gatheraxis=1, scatteraxis=0,
+                           numelem=1) ** 2))(x),
+            jnp.ones((NR, 2)))
+        assert got == only(all_to_all=2)
+
     def test_p2p_ring_fwd_bwd_is_two_collective_permutes(self):
         # Gradients ride the reverse ring: one fused permute per
         # direction (csrc/extension.cpp:1159-1218's tag+10 discipline,

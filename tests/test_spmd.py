@@ -827,6 +827,109 @@ class TestCommFromMesh:
                 pass
 
 
+class TestAlltoallCrossModeParity:
+    """ISSUE 9 satellite: Alltoall was the one facade collective with no
+    cross-mode bitwise-parity matrix (the reduction family has one in
+    TestDeterministic* above) — and the reshard executor leans on it.
+    Mode A (compiled all_to_all) and Mode B (rendezvous gather+scatter)
+    must agree BITWISE on general float data, forward and backward, on
+    (3,), (8,) and the (2,4)-mesh worlds."""
+
+    @staticmethod
+    def _data(n, k=4):
+        rng = np.random.default_rng(n)
+        return rng.standard_normal((n, n * k)).astype(np.float64)
+
+    @pytest.mark.parametrize("n", [3, 8])
+    def test_forward_bitwise(self, n):
+        data = self._data(n)
+
+        def spmd_body():
+            t = jnp.asarray(data)[jnp.asarray(comm.rank + 0)]
+            t = t.reshape(n, -1)
+            return comm.Alltoall(t, gatheraxis=1, scatteraxis=0,
+                                 numelem=1)
+
+        a = np.asarray(mpi.run_spmd(spmd_body, nranks=n)())
+
+        def eager_body():
+            t = jnp.asarray(data)[comm.rank].reshape(n, -1)
+            return comm.Alltoall(t, gatheraxis=1, scatteraxis=0,
+                                 numelem=1)
+
+        b = mpi.run_ranks(eager_body, n)
+        for r in range(n):
+            assert np.array_equal(a[r], np.asarray(b[r])), r
+
+    @pytest.mark.parametrize("n", [3, 8])
+    def test_backward_bitwise(self, n):
+        data = self._data(n)
+        w = np.random.default_rng(n + 100).standard_normal(
+            (n, n, self._data(n).shape[1] // n))
+
+        def loss(c, t, wr):
+            y = c.Alltoall(t, gatheraxis=1, scatteraxis=0, numelem=1)
+            return jnp.vdot(y, wr)
+
+        def spmd_body():
+            t = jnp.asarray(data)[jnp.asarray(comm.rank + 0)]
+            t = t.reshape(n, -1)
+            wr = jnp.asarray(w)[jnp.asarray(comm.rank + 0)]
+            return jax.grad(lambda v: loss(comm, v, wr))(t)
+
+        a = np.asarray(mpi.run_spmd(spmd_body, nranks=n)())
+
+        def eager_body():
+            t = jnp.asarray(data)[comm.rank].reshape(n, -1)
+            wr = jnp.asarray(w)[comm.rank]
+            return jax.grad(lambda v: loss(comm, v, wr))(t)
+
+        b = mpi.run_ranks(eager_body, n)
+        for r in range(n):
+            assert np.array_equal(a[r], np.asarray(b[r])), r
+
+    def test_2d_mesh_per_axis_vs_local_oracle(self):
+        # The (2,4) world: one Alltoall per mesh axis inside a 2D
+        # shard_map, each checked against the local transpose oracle.
+        from jax.sharding import Mesh, PartitionSpec as P
+        from mpi4torch_tpu._compat import shard_map
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                    ("a", "b"))
+        rng = np.random.default_rng(7)
+        data = rng.standard_normal((2, 4, 4, 6)).astype(np.float64)
+
+        for axis, size in (("a", 2), ("b", 4)):
+            c = mpi.comm_from_mesh(mesh, axis)
+
+            def body(x):
+                ia = jax.lax.axis_index("a")
+                ib = jax.lax.axis_index("b")
+                t = jnp.asarray(data)[ia, ib].reshape(size, -1)
+                y = c.Alltoall(t, gatheraxis=1, scatteraxis=0,
+                               numelem=1)
+                return jnp.expand_dims(jnp.expand_dims(y, 0), 0)
+
+            out = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=P(),
+                out_specs=P("a", "b"), check_vma=False))(
+                    jnp.zeros(()))
+            out = np.asarray(out)
+            for ra in range(2):
+                for rb in range(4):
+                    me = (ra, rb)
+                    group = [(i, rb) for i in range(2)] if axis == "a" \
+                        else [(ra, j) for j in range(4)]
+                    pos = group.index(me)
+                    pieces = [
+                        data[g].reshape(size, -1)[pos] for g in group]
+                    want = np.concatenate(
+                        [p.reshape(1, -1) for p in pieces], axis=1)
+                    got = out[ra, rb]
+                    assert np.array_equal(got.reshape(1, -1), want), \
+                        (axis, ra, rb)
+
+
 def test_no_private_jax_imports():
     # VERDICT round 1: `jax._src` is version-unstable; the package must
     # stick to public API (jax.core re-exports included).
