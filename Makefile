@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -89,6 +89,21 @@ reshard-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.reshard --smoke
+
+# CPU smoke run of the inference-serving subsystem (mpi4torch_tpu.serve)
+# on the 8-virtual-device mesh: the continuous-batching engine checked
+# BITWISE against the per-request generate() oracle across
+# admission/eviction churn under EVERY registered scheduling policy
+# (registry-sync guard), the scheduled-exposure census of the decode
+# step (overlap < 1.0, blocking == 1.0), the latency-tier selection
+# assertion on the real decode message sizes (selector pick + the
+# resolved Allreduce_start.<algo> spans in the lowered program), and a
+# rank_death-mid-decode attribution cell.  Exits non-zero on any
+# divergence.
+serve-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.serve --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -24,7 +24,7 @@ from pathlib import Path
 
 DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
-PAGES = ["index", "basic_usage", "examples", "parallelism",
+PAGES = ["index", "basic_usage", "examples", "parallelism", "serving",
          "compression", "fusion", "algorithms", "overlap", "resilience",
          "reshard", "api_reference", "design_tpu", "glossary"]
 

@@ -69,6 +69,7 @@ from . import tune
 from . import overlap
 from . import resilience
 from . import reshard
+from . import serve
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
@@ -117,6 +118,7 @@ __all__ = [
     "overlap",
     "resilience",
     "reshard",
+    "serve",
     "SpmdWaitHandle",
     "FaultPlan",
     "FaultSpec",

@@ -462,12 +462,16 @@ class MPI_Communicator:
         ``compression=`` raises, a scope/process codec default degrades
         to the exact wire.  ``algorithm`` follows the :meth:`Allreduce`
         contract (non-ring schedules run whole in phase 1, the Wait
-        being their completion point)."""
+        being their completion point), including the scope suffix: the
+        op's named scope is owned by the overlap facade body so the
+        RESOLVED algorithm can suffix it
+        (``mpi4torch.Allreduce_start.rhd`` in lowered programs — the
+        deterministic latency-tier evidence ``make serve-smoke``
+        asserts)."""
         from .overlap import allreduce_start
-        with jax.named_scope("mpi4torch.Allreduce_start"):
-            return allreduce_start(self, tensor, op,
-                                   compression=compression,
-                                   algorithm=algorithm)
+        return allreduce_start(self, tensor, op,
+                               compression=compression,
+                               algorithm=algorithm)
 
     def Reduce_scatter_start(self, tensor, op: int,
                              scatteraxis: int) -> WaitHandle:

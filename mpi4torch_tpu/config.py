@@ -484,6 +484,32 @@ def set_quant_hop_impl(impl: str) -> None:
     _quant_hop_impl = impl
 
 
+# Split count of the serving decode step's per-layer TP collectives
+# (mpi4torch_tpu.serve): each tiny per-token allreduce payload is split
+# into this many windowed split-phase chunk buckets so >= 2 transfers
+# stay in flight (the overlap scheduler's window, applied WITHIN one
+# collective site — decode has no independent second collective stream
+# to pair with).  2 (default) is the double-buffered sweet spot for
+# payloads this small; 1 degenerates to a single split-phase pair
+# (start/wait with an empty window — censuses exposed).  Only read when
+# the engine's overlap policy is on; part of the trace-time fingerprint.
+DEFAULT_SERVE_DECODE_BUCKETS = 2
+
+_serve_decode_buckets = DEFAULT_SERVE_DECODE_BUCKETS
+
+
+def serve_decode_buckets() -> int:
+    """How many windowed split-phase chunk buckets one serving decode
+    collective is split into (:mod:`mpi4torch_tpu.serve`; >= 1)."""
+    return _serve_decode_buckets
+
+
+def set_serve_decode_buckets(n) -> None:
+    global _serve_decode_buckets
+    _serve_decode_buckets = _validated_threshold(
+        n, "serve_decode_buckets", minimum=1, unit="bucket count")
+
+
 # Default planning strategy of the resharding subsystem
 # (mpi4torch_tpu.reshard): "auto" lets the planner walk its preference
 # order (local < permute < allgather < alltoall < rounds — gather, the
@@ -669,7 +695,8 @@ def thresholds_fingerprint():
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
             _hier_group_size, _chain_unroll_max, _quant_hop_impl,
-            _comm_finite_guard, _reshard_strategy)
+            _comm_finite_guard, _reshard_strategy,
+            _serve_decode_buckets)
 
 
 @contextmanager

@@ -188,14 +188,26 @@ def _rope_rotate(cfg: TransformerConfig, x, positions):
     learned absolute table hard-caps at max_seq).  ``positions`` (s,) may
     be traced (rank-symbolic global offsets under SPMD), so the sharded
     shards of one sequence rotate consistently and ring/Ulysses need no
-    special handling: q/k are rotated BEFORE any transport."""
+    special handling: q/k are rotated BEFORE any transport.
+
+    ``positions`` may also be ``(b, s)`` — per-ROW positions, the
+    continuous-batching decode path (:mod:`mpi4torch_tpu.serve`) where
+    every slot of the batch sits at its own position.  The rotation is
+    per head-dim channel, so tensor-parallel head sharding composes
+    unchanged either way."""
     hd = x.shape[-1]
     half = hd // 2
     ct = _compute_dtype_rope(x)
     inv = cfg.rope_theta ** (-jnp.arange(half, dtype=ct) * 2.0 / hd)
-    ang = positions.astype(ct)[:, None] * inv[None, :]        # (s, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    positions = jnp.asarray(positions)
+    if positions.ndim == 1:
+        ang = positions.astype(ct)[:, None] * inv[None, :]    # (s, half)
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:
+        ang = positions.astype(ct)[..., None] * inv           # (b, s, half)
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half].astype(ct), x[..., half:].astype(ct)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
@@ -512,6 +524,15 @@ def _select_token(logits, key, temperature: float, top_k: int, dtype):
         logits = jnp.where(logits >= kth, logits, -jnp.inf)
     return jax.random.categorical(
         key, logits / temperature, axis=-1).astype(dtype)
+
+
+def select_token(logits, key, temperature: float, top_k: int, dtype):
+    """Public decoding-choice rule — THE sampling function of
+    :func:`generate`, exported so the serving engine
+    (:mod:`mpi4torch_tpu.serve`) samples every slot with the identical
+    rule and key discipline: engine-vs-``generate()`` token parity holds
+    by construction rather than by parallel edits."""
+    return _select_token(logits, key, temperature, top_k, dtype)
 
 
 def generate(cfg: TransformerConfig, params, prompt, n_new: int,

@@ -164,18 +164,34 @@ def _jnp_block(q, k, v, q_off, kv_off, causal: bool, window: int = 0):
     s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(ct), k.astype(ct),
                    precision=prec) * scale
     if causal:
-        q_pos = q_off + jnp.arange(sq, dtype=jnp.int32)
-        kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
-        mask = q_pos[:, None] >= kv_pos[None, :]
-        if window:
-            # Sliding window: q attends the last `window` positions
-            # (itself included) — q_pos - window < kv_pos <= q_pos.
-            mask &= (q_pos[:, None] - kv_pos[None, :]) < window
-        s = jnp.where(mask[None, :, None, :], s, NEG_BIG)
+        batched = q_off.ndim > 0 or kv_off.ndim > 0
+        if not batched:
+            q_pos = q_off + jnp.arange(sq, dtype=jnp.int32)
+            kv_pos = kv_off + jnp.arange(sk, dtype=jnp.int32)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            if window:
+                # Sliding window: q attends the last `window` positions
+                # (itself included) — q_pos - window < kv_pos <= q_pos.
+                mask &= (q_pos[:, None] - kv_pos[None, :]) < window
+            bmask = mask[None, :, None, :]
+        else:
+            # Per-row offsets (the continuous-batching decode path,
+            # mpi4torch_tpu.serve): each batch row sits at its OWN
+            # global position, so the causal/window frontier is per
+            # row.  Same mask algebra, one extra leading axis.
+            q_pos = q_off[..., None] + jnp.arange(sq, dtype=jnp.int32)
+            kv_pos = kv_off[..., None] + jnp.arange(sk, dtype=jnp.int32)
+            mask = (q_pos[..., :, None] >= kv_pos[..., None, :])
+            if window:
+                mask &= (q_pos[..., :, None]
+                         - kv_pos[..., None, :]) < window
+            mask = jnp.broadcast_to(mask, (b, sq, sk))
+            bmask = mask[:, :, None, :]
+        s = jnp.where(bmask, s, NEG_BIG)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     if causal:
-        p = jnp.where(mask[None, :, None, :], p, 0.0)
+        p = jnp.where(bmask, p, 0.0)
     l = jnp.sum(p, axis=-1)
     acc = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(ct), precision=prec)
     safe_l = jnp.where(l > 0, l, 1.0)
@@ -929,6 +945,25 @@ def flash_block_attention(q, k, v, *, causal: bool = False, q_offset=0,
             "is defined over the causal mask)")
     q_off = jnp.asarray(q_offset, jnp.int32)
     kv_off = jnp.asarray(kv_offset, jnp.int32)
+    if q_off.ndim > 0 or kv_off.ndim > 0:
+        # Per-row offsets (shape ``(batch,)``): the continuous-batching
+        # decode path of mpi4torch_tpu.serve, where every slot of the
+        # batch sits at its own position.  jnp-only (the kernels key
+        # their tile skipping off ONE scalar frontier) and forward-only
+        # — serving decode never differentiates.
+        for name, off in (("q_offset", q_off), ("kv_offset", kv_off)):
+            if off.ndim > 1 or (off.ndim == 1
+                                and off.shape[0] != q.shape[0]):
+                raise ValueError(
+                    f"{name} must be a scalar or a (batch,) vector of "
+                    f"per-row positions; got shape {off.shape} for "
+                    f"batch {q.shape[0]}")
+        if impl == "pallas":
+            raise ValueError(
+                "per-row q_offset/kv_offset vectors ride the jnp path "
+                "only (the Pallas kernels tile-skip off one scalar "
+                "frontier); use impl='jnp' or 'auto'")
+        impl = "jnp"
     return _block(q, k, v, q_off, kv_off, causal, impl, window)
 
 

@@ -31,6 +31,23 @@ def segment_mask(counts, capacity: int):
     return (pos < jnp.asarray(counts)[..., None]).astype(jnp.int32)
 
 
+def position_onehot(pos, capacity: int):
+    """``(...,)`` (or scalar) int positions → ``(..., capacity)`` one-hot
+    0/1 int32 mask selecting exactly slot ``pos``.
+
+    The single-position counterpart of :func:`segment_mask`, and the
+    per-slot KV-cache write mask of the continuous-batching decode step
+    (:mod:`mpi4torch_tpu.serve`): each slot of the batch writes its new
+    K/V row at its OWN position, so the scalar-``pos``
+    ``dynamic_update_slice`` of the single-sequence decode path becomes
+    a masked ``where`` over the static ``max_seq`` buffer — same static
+    shapes, one compiled program for any mix of per-slot positions.
+    Out-of-range positions produce an all-zero row (no write), which is
+    what an inactive slot wants."""
+    p = jnp.arange(capacity)
+    return (p == jnp.asarray(pos)[..., None]).astype(jnp.int32)
+
+
 def _masked(x, counts, capacity: int):
     m = segment_mask(counts, capacity)
     m = m.reshape(m.shape + (1,) * (x.ndim - m.ndim))

@@ -66,7 +66,7 @@ import jax.numpy as jnp
 from .. import config as _config
 from ..comm import WaitHandle
 from ..ops.eager import join_dummies as _join_dummies
-from ..runtime import BifurcationError
+from ..runtime import BifurcationError, CommError
 
 __all__ = [
     "SPLIT_PHASE_FORMS",
@@ -79,6 +79,7 @@ __all__ = [
     "overlap_depth",
     "overlap_allreduce_tree",
     "overlap_reduce_scatter_tree",
+    "overlap_split_allreduce",
     "prefetch_allgather_tree",
     "scheduled_exposure",
 ]
@@ -164,7 +165,16 @@ def allreduce_start(comm, tensor, op: int, compression=None,
     the blocking :meth:`~mpi4torch_tpu.MPI_Communicator.Allreduce`
     (``MPI_Communicator._allreduce_plan``), then the split-phase rule —
     split transfers are exact, so an explicit codec raises and a scope
-    default degrades to the exact wire."""
+    default degrades to the exact wire.
+
+    Owns the op's named scope so the RESOLVED algorithm can suffix it
+    (``mpi4torch.Allreduce_start.rhd``), exactly like the blocking
+    ``Allreduce``'s scope: a lowered program then carries deterministic
+    evidence of which wire schedule each split-phase transfer took —
+    what ``make serve-smoke`` reads to prove decode collectives landed
+    in the latency tier."""
+    import jax as _jax
+
     backend, codec, algo, algo_explicit = comm._allreduce_plan(
         tensor, op, compression, algorithm)
     if codec is not None:
@@ -175,13 +185,42 @@ def allreduce_start(comm, tensor, op: int, compression=None,
                 "collective with no start/wait form; use the blocking "
                 "Allreduce, or compression=False to split-phase exact")
         codec = None  # scope default yields: exact split-phase wire
-    if _is_spmd_backend(backend):
-        raw = backend.allreduce_start(tensor, op, algorithm=algo,
-                                      algorithm_explicit=algo_explicit)
-        return SpmdWaitHandle(raw)
-    val = backend.allreduce(tensor, op, algorithm=algo,
-                            algorithm_explicit=algo_explicit)
-    return _start_generic("Allreduce", val)
+    if algo is None and _is_spmd_backend(backend):
+        # Resolve auto selection HERE (the same trace-time selector the
+        # backend would run) so the scope suffix below reflects the
+        # schedule the wire actually takes — the facade passing the
+        # resolved name through changes nothing else: the backend's
+        # pair/whole-fold dispatch treats an explicitly-passed selector
+        # pick exactly like its own auto resolution.
+        from ..ops.spmd import _auto_allreduce_algorithm
+        algo = _auto_allreduce_algorithm(backend._ctx, tensor)
+    scope = "mpi4torch.Allreduce_start"
+    suffix = algo
+    if suffix in ("hier", "torus") and not getattr(
+            backend, "owns_algorithm_resolution", False):
+        # A scope-default hier/torus can still degrade to ring INSIDE
+        # the backend when the group rule fails for this communicator
+        # (config.hier_group_size not dividing it); a span naming a
+        # schedule the wire never ran would falsify the census, so the
+        # suffix applies only when the group validation the backend
+        # will run passes.  (Auto picks are pre-gated by select_auto;
+        # explicit failures raise rather than degrade.)
+        from ..tune import resolve_hier_group
+        try:
+            resolve_hier_group(backend.size)
+        except CommError:
+            suffix = None
+    if suffix not in (None, "ring"):
+        scope += f".{suffix}"
+    with _jax.named_scope(scope):
+        if _is_spmd_backend(backend):
+            raw = backend.allreduce_start(
+                tensor, op, algorithm=algo,
+                algorithm_explicit=algo_explicit)
+            return SpmdWaitHandle(raw)
+        val = backend.allreduce(tensor, op, algorithm=algo,
+                                algorithm_explicit=algo_explicit)
+        return _start_generic("Allreduce", val)
 
 
 def reduce_scatter_start(comm, tensor, op: int,
@@ -224,5 +263,6 @@ def overlap_depth(value, default: int = 2) -> int:
 # parallel/ helpers route through these).
 from .scheduler import (overlap_allreduce_tree,            # noqa: E402
                         overlap_reduce_scatter_tree,
+                        overlap_split_allreduce,
                         prefetch_allgather_tree)
 from .census import scheduled_exposure                     # noqa: E402

@@ -63,12 +63,21 @@ def _windowed(nb: int, depth: int, start, finish) -> None:
 
 class _Window:
     """Shared start/wait bookkeeping: handles per bucket, plus the
-    youngest started handle so each Wait can be order-tied after it."""
+    youngest started handle so each Wait can be order-tied after it.
 
-    def __init__(self, comm, op: str, nb: int):
+    ``label_base``/``label_total`` offset the bucket-scope labels:
+    :func:`overlap_split_allreduce` runs several windows within ONE
+    program (one per decode collective site) and the scheduled-exposure
+    census groups ops by their ``bucket<i>of<n>`` span, so every
+    window's buckets must be globally distinct."""
+
+    def __init__(self, comm, op: str, nb: int, label_base: int = 0,
+                 label_total: int = None):
         self.comm = comm
         self.op = op
         self.nb = nb
+        self.label_base = label_base
+        self.label_total = nb if label_total is None else label_total
         self.handles = {}
         self.results = [None] * nb
         self.youngest = None
@@ -88,8 +97,57 @@ class _Window:
             # by the transpose, orders the backward chain).
             from ..comm import JoinDummiesHandle
             h = JoinDummiesHandle(h, [self.youngest.dummy])
-        with bucket_scope(self.op, i, self.nb, phase="wait"):
+        with bucket_scope(self.op, self.label_base + i, self.label_total,
+                          phase="wait"):
             self.results[i] = self.comm.Wait(h)
+
+
+def overlap_split_allreduce(comm, x, op: int, *, nsplits: int = 2,
+                            index_base: int = 0, index_total: int = None,
+                            op_name: str = "Allreduce_split",
+                            algorithm=None):
+    """Split-phase allreduce of ONE payload as ``nsplits`` windowed
+    chunk buckets — the decode-collective primitive of
+    :mod:`mpi4torch_tpu.serve`.
+
+    A per-token decode collective is a few KiB with nothing independent
+    to hide behind (the next op consumes its result), so the overlap
+    window is built WITHIN the call: the flat payload splits into
+    ``nsplits`` chunks, every chunk's collective is started before any
+    is waited on, and each Wait is order-tied behind the youngest start
+    — so while chunk 0 completes, chunk 1's transfer is already on the
+    wire (>= 2 in flight, the invariant :func:`~mpi4torch_tpu.overlap.
+    scheduled_exposure` censuses).  An elementwise SUM is unchanged by
+    chunking, so the result is BITWISE the blocking ``comm.Allreduce``
+    on both backends (deterministic mode included: the per-element
+    ascending-rank fold never crosses chunk boundaries).
+
+    ``index_base``/``index_total`` make this call's bucket-scope labels
+    globally unique when several sites run in one program (the serving
+    decode step numbers ``2 * n_layers`` sites).  ``algorithm`` follows
+    the ``Allreduce`` contract per chunk — auto selection keys on the
+    CHUNK size, i.e. the real decode message the wire carries.
+    Split-phase transfers are exact (a codec scope degrades, as in
+    :meth:`~mpi4torch_tpu.MPI_Communicator.Allreduce_start`)."""
+    x = jnp.asarray(x)
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    nsplits = max(min(int(nsplits), max(n, 1)), 1)
+    bounds = [n * i // nsplits for i in range(nsplits + 1)]
+    chunks = [flat[bounds[i]:bounds[i + 1]] for i in range(nsplits)]
+    total = nsplits if index_total is None else index_total
+    win = _Window(comm, op_name, nsplits, label_base=index_base,
+                  label_total=total)
+
+    def start(i):
+        with bucket_scope(op_name, index_base + i, total, phase="start"):
+            win.started(i, comm.Allreduce_start(
+                chunks[i], op, compression=False, algorithm=algorithm))
+
+    # Full-depth window: all starts issued, then the waits — for a
+    # handful of chunk buckets the maximal in-flight set is the point.
+    _windowed(nsplits, nsplits, start, win.finish)
+    return jnp.concatenate(win.results).reshape(x.shape)
 
 
 def overlap_allreduce_tree(comm, buckets: Sequence, layout, op: int, *,

@@ -49,6 +49,31 @@ def shard_axis(comm, x, axis: int):
     return jax.lax.dynamic_slice_in_dim(x, start, local, axis)
 
 
+def shard_heads(comm, w, n_heads: int, axis: int = 1):
+    """This rank's whole-head shard of a head-structured projection.
+
+    ``w``'s ``axis`` is laid out as ``n_heads`` contiguous equal head
+    blocks (the ``wqkv``/``wo`` convention of models/transformer.py);
+    the shard keeps ``n_heads / size`` WHOLE heads — the tensor-parallel
+    attention contract (each rank owns its heads end-to-end, so the
+    per-head softmax never crosses ranks).  This is the one place the
+    head-alignment rule is validated; the serving KV layer
+    (:mod:`mpi4torch_tpu.serve`) builds its q/k/v and output-projection
+    shards through it.  Trace-safe like :func:`shard_axis` (which does
+    the slicing once the alignment holds)."""
+    size = comm.size
+    n = w.shape[axis]
+    if n_heads <= 0 or n % n_heads != 0:
+        raise ValueError(
+            f"axis {axis} length {n} is not a whole number of "
+            f"{n_heads} head blocks")
+    if n_heads % size != 0:
+        raise ValueError(
+            f"n_heads ({n_heads}) not divisible by world size ({size}) "
+            "— tensor-parallel attention shards whole heads only")
+    return shard_axis(comm, w, axis)
+
+
 def column_parallel_linear(comm, x, w_shard, b_shard=None,
                            gather_output: bool = True):
     """``y = x @ W + b`` with ``W`` sharded column-wise (output features).

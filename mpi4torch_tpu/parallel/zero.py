@@ -195,7 +195,8 @@ def zero3_init(comm, opt, params):
     return p_shards, opt.init(p_shards)
 
 
-def zero3_to_tp(comm, p_shards, template, tp_specs, strategy=None):
+def zero3_to_tp(comm, p_shards, template, tp_specs, strategy=None,
+                dtype=None):
     """ZeRO-shard -> TP-shard handoff at the train/serve boundary
     (:mod:`mpi4torch_tpu.reshard`): turn this rank's persistent ZeRO-3
     flat shards into its TENSOR-PARALLEL shards under ``tp_specs`` (one
@@ -215,7 +216,12 @@ def zero3_to_tp(comm, p_shards, template, tp_specs, strategy=None):
     (transformer matrices have ``d_model % size == 0``).
 
     Returns the TP shard tree.  Differentiable like every facade op
-    (the VJP redistributes cotangents TP -> ZeRO)."""
+    (the VJP redistributes cotangents TP -> ZeRO).  ``dtype`` casts the
+    resulting TP shards AFTER the exchange — the serving-precision
+    override at the handoff (e.g. bf16 serve shards from f32 training
+    state, the :mod:`mpi4torch_tpu.serve` admission recipe): the wire
+    moves the checkpoint's exact bits, only the serve-side copy is
+    lowered."""
     import numpy as _np
 
     from .. import reshard as _rs
@@ -246,7 +252,10 @@ def zero3_to_tp(comm, p_shards, template, tp_specs, strategy=None):
         repl_nd = _rs.Layout((size,), ((),) * len(tshape))
         return comm.Reshard(full, repl_nd, tp_lay)
 
-    return jax.tree.map(one, p_shards, template, tp_tree)
+    out = jax.tree.map(one, p_shards, template, tp_tree)
+    if dtype is not None:
+        out = jax.tree.map(lambda x: x.astype(dtype), out)
+    return out
 
 
 def zero3_step(comm, opt, p_shards, template, local_loss_fn, opt_state,

@@ -1,0 +1,207 @@
+"""`python -m mpi4torch_tpu.serve --smoke` — the serve-smoke lane.
+
+End-to-end check of the serving subsystem on whatever devices are
+attached (the Makefile's ``serve-smoke`` target runs it on the
+8-virtual-device CPU mesh):
+
+1. **engine-vs-oracle bitwise** — the continuous-batching engine's
+   tokens vs per-request ``generate()``, with admission/eviction churn
+   (4 requests through 2 slots), under EVERY registered scheduling
+   policy — the registry-sync guard: a policy added to
+   ``serve.POLICIES`` without appearing in ``PARITY_POLICIES`` (and
+   thus this matrix) fails the lane;
+2. **scheduled-exposure census** — the lowered Mode A decode step with
+   the overlap schedule censuses strictly < 1.0 exposed decode
+   collectives (the blocking baseline censuses 1.0 by construction);
+3. **latency-tier selection** — with a measured latency crossover in
+   place, ``serve.latency_report`` picks a latency-optimal algorithm
+   for the real decode chunk sizes AND the lowered program carries the
+   resolved ``Allreduce_start.<algo>`` span with no bandwidth-tier
+   schedule anywhere in the decode step;
+4. **fault composition** — a ``rank_death`` injected mid-decode on the
+   eager world raises an attributed ``RankFailedError``.
+
+Exits non-zero on any divergence, so the lane is a real check, not a
+demo.
+"""
+
+from __future__ import annotations
+
+import sys
+
+# The parity-covered policies: must equal serve.POLICIES (checked
+# below) so scheduling policies can never ship without oracle-parity
+# coverage — the registry-sync guard discipline of test_tune/
+# test_overlap, applied to admission scheduling.
+PARITY_POLICIES = ("fcfs", "shortest_first")
+
+
+def _smoke() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu import serve
+    from mpi4torch_tpu._compat import lowered_text
+    from mpi4torch_tpu.models import transformer as T
+
+    ndev = len(jax.devices())
+    size = 4 if ndev >= 4 else (2 if ndev >= 2 else 1)
+    print(f"serve-smoke: {ndev} device(s), platform "
+          f"{jax.devices()[0].platform}, TP world ({size},)")
+
+    cfg = T.TransformerConfig(vocab=61, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, max_seq=32)
+    params = T.init_transformer(jax.random.PRNGKey(0), cfg,
+                                dtype=jnp.float32)
+    prompts = [np.array([1, 2, 3]), np.array([4, 5, 6, 7, 8]),
+               np.array([9, 10]), np.array([11, 12, 13, 14])]
+    budgets = [6, 4, 5, 3]
+
+    def oracle(p, n):
+        return np.asarray(T.generate(
+            cfg, params, jnp.asarray(p, jnp.int32)[None, :], n,
+            dtype=jnp.float32)[0])
+
+    want = [oracle(p, n) for p, n in zip(prompts, budgets)]
+
+    # 1. Registry-sync guard + the engine-vs-oracle parity matrix.
+    if tuple(sorted(serve.POLICIES)) != tuple(sorted(PARITY_POLICIES)):
+        print(f"FAIL: policy registry {sorted(serve.POLICIES)} != "
+              f"parity-covered set {sorted(PARITY_POLICIES)} — every "
+              "scheduling policy needs oracle-parity coverage")
+        return 1
+
+    def check(results, label) -> bool:
+        for i, w in enumerate(want):
+            if not np.array_equal(np.asarray(results[i]), w):
+                print(f"FAIL: {label}: request {i} tokens diverge from "
+                      f"per-request generate()")
+                return False
+        return True
+
+    for policy in sorted(serve.POLICIES):
+        eng = serve.Engine(
+            cfg, params,
+            serve.ServeConfig(slots=2, policy=policy, overlap=True),
+            spmd=True, nranks=size)
+        for p, n in zip(prompts, budgets):
+            eng.submit(p, max_new=n)
+        if not check(eng.run(), f"Mode A ({size},) policy={policy}"):
+            return 1
+    print(f"engine: bitwise == per-request generate() on ({size},), "
+          f"both policies, across slot churn "
+          f"({len(prompts)} requests / 2 slots)")
+
+    if size > 1:
+        def fn(rank):
+            e = serve.Engine(cfg, params,
+                             serve.ServeConfig(slots=2, overlap=True))
+            for p, n in zip(prompts, budgets):
+                e.submit(p, max_new=n)
+            return e.run()
+
+        outs = mpi.run_ranks(fn, size, timeout=300.0)
+        if not check(outs[0], f"Mode B ({size},)"):
+            return 1
+        print(f"engine: Mode B ({size},) rank threads bitwise == oracle")
+
+    # 2. Scheduled-exposure census of the decode step.
+    census = {}
+    for name, ov in (("overlap", True), ("blocking", False)):
+        eng = serve.Engine(cfg, params,
+                           serve.ServeConfig(slots=2, overlap=ov),
+                           spmd=True, nranks=size)
+        eng.submit(prompts[0], max_new=3)
+        eng.step()
+        census[name] = mpi.overlap.scheduled_exposure(eng.lower_step())
+    co, cb = census["overlap"], census["blocking"]
+    print(f"scheduled exposure: overlap {co['exposed_fraction']} "
+          f"({co['n_buckets']} buckets), blocking "
+          f"{cb['exposed_fraction']} ({cb['n_buckets']} buckets)")
+    if size > 1:
+        if not (co["n_buckets"] and co["exposed_fraction"] < 1.0):
+            print("FAIL: overlap decode schedule does not census "
+                  "< 1.0 exposed")
+            return 1
+        if cb["exposed_fraction"] != 1.0:
+            print("FAIL: blocking decode baseline should census 1.0")
+            return 1
+
+    # 3. Latency-tier selection on the real decode message sizes.
+    prev = mpi.config.latency_crossover_bytes()
+    mpi.config.set_latency_crossover_bytes(1 << 14)
+    try:
+        rep = serve.latency_report(cfg, serve.ServeConfig(slots=2),
+                                   size, jnp.float32)
+        print(f"latency tier: {rep['chunk_bytes']} B decode chunks "
+              f"(cache bucket {rep['cache_bucket_bytes']}) -> "
+              f"{rep['algorithm']}")
+        if size > 1 and not rep["latency_tier"]:
+            print(f"FAIL: decode selection {rep} did not land in the "
+                  "latency tier under the measured crossover")
+            return 1
+        eng = serve.Engine(cfg, params,
+                           serve.ServeConfig(slots=2, overlap=True),
+                           spmd=True, nranks=size)
+        eng.submit(prompts[0], max_new=3)
+        eng.step()
+        txt = lowered_text(eng.lower_step(), debug_info=True)
+        if size > 1:
+            if f"Allreduce_start.{rep['algorithm']}" not in txt:
+                print("FAIL: lowered decode step does not carry the "
+                      f"resolved Allreduce_start.{rep['algorithm']} "
+                      "span")
+                return 1
+            if ".bidir" in txt or ".torus" in txt:
+                print("FAIL: a bandwidth-tier schedule leaked into the "
+                      "decode step")
+                return 1
+            print(f"latency tier: lowered decode step carries "
+                  f"Allreduce_start.{rep['algorithm']} spans, no "
+                  "bandwidth-tier schedule")
+        res = eng.run()
+        if not np.array_equal(np.asarray(res[0]),
+                              oracle(prompts[0], 3)):
+            print("FAIL: latency-tier engine diverges from the oracle")
+            return 1
+    finally:
+        mpi.config.set_latency_crossover_bytes(prev)
+
+    # 4. Fault composition: rank death mid-decode, attributed.
+    if ndev >= 2:
+        from mpi4torch_tpu import resilience as rz
+
+        def dying(rank):
+            e = serve.Engine(cfg, params, serve.ServeConfig(slots=2))
+            e.submit(prompts[0], max_new=4)
+            return e.run()
+
+        try:
+            with rz.fault_scope([rz.FaultSpec(
+                    "rank_death", rank=1, op="Allreduce",
+                    index=2 * cfg.n_layers)]):
+                mpi.run_ranks(dying, 2, timeout=20.0)
+            print("FAIL: rank_death mid-decode did not raise")
+            return 1
+        except mpi.RankFailedError as e:
+            if e.ranks != frozenset({1}):
+                print(f"FAIL: RankFailedError misattributed: {e.ranks}")
+                return 1
+        print("faults: rank_death mid-decode -> RankFailedError(ranks="
+              "{1}) on every survivor")
+
+    print("serve-smoke: OK")
+    return 0
+
+
+def main(argv) -> int:
+    if "--smoke" in argv or not argv:
+        return _smoke()
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,504 @@
+"""The continuous-batching serving engine.
+
+One fixed-capacity **slot table** (``ServeConfig.slots`` concurrent
+sequences), one compiled decode-step program, a host-driven loop:
+
+* **admission** — each step starts by filling free slots from the
+  request queue (the active :data:`POLICIES` entry picks the order).
+  A request is admitted by a per-request TP prefill at its TRUE prompt
+  length (exactly what ``generate()`` does — the engine's first token
+  and the oracle's come from the same batched-prefill logits), whose
+  cache rows are installed into the free slot.  Prefill compiles per
+  distinct prompt length, like ``generate`` itself; the DECODE loop
+  never retraces.
+* **decode** — one :func:`~mpi4torch_tpu.serve.decode_step_tp` call
+  over the whole slot table per step: static shapes, per-slot
+  positions, free slots riding along as NaN-poisoned inert rows
+  (ops/ragged masks; see kv.py).  Sampling runs host-side with
+  ``models/transformer.select_token`` under the exact per-request key
+  discipline of ``generate()`` — engine tokens equal per-request
+  ``generate()`` tokens by construction.
+* **eviction** — a slot finishes on EOS or its token budget; its cache
+  rows are re-poisoned and the slot returns to the free pool, ready
+  for the next admission in the SAME step loop — no batch barrier,
+  which is the whole point of continuous batching.
+
+Two execution modes behind one engine:
+
+* **eager / Mode B** — construct the engine inside a ``run_ranks``
+  rank thread (or on the plain single-device world): collectives run
+  through the eager rendezvous, so PR 7 fault plans compose at the
+  chokepoints — a ``rank_death`` mid-decode surfaces as an attributed
+  ``RankFailedError`` on every survivor, never a hang.
+* **SPMD / Mode A** — ``Engine(..., spmd=True, nranks=4)`` (or
+  ``mesh=``/``axis_name=``): the decode step is ONE ``run_spmd``
+  program; per-rank KV shards ride between steps as a stacked
+  ``(size, ...)`` leading axis (sliced by rank in-trace, re-stacked by
+  the rank-major output convention — on the CPU harness this means
+  each device holds the full stacked cache; a production deployment
+  would pin the axis sharded, which changes none of the semantics
+  here).  :meth:`Engine.lower_step` exposes the lowered step for the
+  deterministic exposure/latency censuses.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import COMM_WORLD
+from ..models.transformer import TransformerConfig, select_token
+from ..runtime import CommError
+from ..utils import profiling as _prof
+from . import kv as _kv
+
+__all__ = ["ServeConfig", "Request", "Engine", "POLICIES",
+           "QueueFullError"]
+
+
+class QueueFullError(CommError):
+    """Raised by :meth:`Engine.submit` when the engine is at capacity
+    (every slot occupied AND the bounded queue full) — the serving
+    backpressure signal a front-end turns into HTTP 429/503."""
+
+
+def _policy_fcfs(queue) -> int:
+    """First come, first served: admit in arrival order."""
+    return 0
+
+
+def _policy_shortest_first(queue) -> int:
+    """Shortest prompt first (stable): cheapest prefill next — a
+    throughput-greedy admission order for mixed prompt lengths."""
+    lens = [len(r.prompt) for r in queue]
+    return int(np.argmin(lens))
+
+
+# Admission scheduling policies: name -> chooser(queue) -> index of the
+# next request to admit.  The serve-smoke lane carries a registry-sync
+# guard (every name here must be covered by the engine-vs-oracle parity
+# matrix) and tests/test_serve.py parametrizes its matrix over this
+# registry, so registering a policy without parity coverage fails CI.
+POLICIES = {
+    "fcfs": _policy_fcfs,
+    "shortest_first": _policy_shortest_first,
+}
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Engine configuration.  ``slots`` is the fixed slot-table
+    capacity (the compiled decode batch); ``max_new`` the default
+    per-request token budget (prompt + budget must fit ``cfg.max_seq``,
+    checked at submit); ``eos`` ends a request early (None = budget
+    only).  ``temperature``/``top_k`` follow the ``generate()``
+    contract per request.  ``overlap`` is the decode-collective
+    schedule (None = ``config.default_overlap()``; truthy = windowed
+    split-phase; False = blocking baseline) and ``algorithm`` an
+    explicit per-call pin (None = latency-tier auto selection).
+    ``queue_limit`` bounds the waiting queue beyond what free slots can
+    immediately absorb: a submit is rejected once
+    ``queued >= queue_limit + free_slots`` (None = unbounded; 0 =
+    accept only what a free slot can take right now)."""
+    slots: int = 4
+    max_new: int = 16
+    eos: Optional[int] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    policy: str = "fcfs"
+    overlap: Any = None
+    algorithm: Optional[str] = None
+    queue_limit: Optional[int] = None
+    cache_dtype: Any = None
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if self.max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {self.max_new}")
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"unknown scheduling policy {self.policy!r}; registered: "
+                f"{sorted(POLICIES)}")
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.queue_limit is not None and self.queue_limit < 0:
+            raise ValueError(
+                f"queue_limit must be >= 0 or None, got "
+                f"{self.queue_limit}")
+
+
+@dataclass(eq=False)
+class Request:
+    """One serving request: ``prompt`` (1-d int array), its token
+    budget, and (for sampled decoding) its own PRNG key — the exact
+    argument set of a per-request ``generate()`` call, which is the
+    engine's parity oracle.  Identity-compared (``eq=False``): the
+    queue removes by object, and array fields have no useful value
+    equality."""
+    rid: Any
+    prompt: np.ndarray
+    max_new: int
+    key: Any = None
+    emitted: List[int] = field(default_factory=list)
+
+    def finished(self, eos: Optional[int]) -> bool:
+        if len(self.emitted) >= self.max_new:
+            return True
+        return (eos is not None and self.emitted
+                and self.emitted[-1] == eos)
+
+
+class Engine:
+    """Continuous-batching inference engine over a fixed slot table.
+
+    Construct with full (replicated) parameters; the TP shards, the
+    sharded KV cache, and the decode collectives follow from the
+    world (see module docstring).  Drive it with :meth:`submit` +
+    :meth:`step`, or :meth:`run` to drain everything.  Greedy and
+    sampled decoding both produce exactly the tokens of a per-request
+    ``models/transformer.generate`` call (tests/test_serve.py holds
+    this across admission/eviction churn on (1,), (4,) and (2,4)
+    worlds, Mode A and Mode B)."""
+
+    def __init__(self, cfg: TransformerConfig, params,
+                 serve_cfg: ServeConfig = None, *, spmd: bool = False,
+                 nranks: Optional[int] = None, mesh=None,
+                 axis_name: Optional[str] = None):
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg or ServeConfig()
+        self._spmd = bool(spmd)
+        self._comm = COMM_WORLD
+        if self._spmd:
+            if mesh is not None:
+                if axis_name is None:
+                    raise ValueError(
+                        "Engine(spmd=True, mesh=...) needs axis_name= — "
+                        "the mesh axis the TP collectives run over "
+                        "(other axes replicate)")
+                self._size = int(mesh.shape[axis_name])
+            else:
+                self._size = int(nranks or len(jax.devices()))
+        else:
+            self._size = self._comm.size
+        _kv.validate_tp(cfg, self._size)
+        self._dtype = (self.serve_cfg.cache_dtype
+                       or params["embed"].dtype)
+
+        if self._spmd:
+            from ..ops.spmd import run_spmd
+            kw = {}
+            if mesh is not None:
+                kw["mesh"] = mesh
+                kw["axis_name"] = axis_name
+            else:
+                kw["nranks"] = self._size
+            # Shard ONCE: the stacked (size, ...) per-rank TP shards
+            # ride as engine state exactly like the KV cache, so the
+            # compiled step slices one rank's shards instead of
+            # re-deriving them from the replicated full parameters
+            # every executed step.
+            self._shards = run_spmd(
+                lambda: _kv.shard_params_tp(cfg, params, COMM_WORLD),
+                **kw)()
+            self._step_call = run_spmd(self._traced_step, **kw)
+            # One wrapper serves every prompt length: the jit under
+            # run_spmd caches per input shape on its own.
+            self._prefill_call = run_spmd(self._traced_prefill, **kw)
+        else:
+            # Eager: the rank is concrete here (rank thread or the
+            # size-1 world) — shard once.
+            self._shards = _kv.shard_params_tp(cfg, params, self._comm)
+            self._step_call = None
+            self._prefill_call = None
+
+        slots = self.serve_cfg.slots
+        cache = _kv.init_kv_cache_tp(cfg, slots, self._size, self._dtype,
+                                     poison=True)
+        if self._spmd:
+            # Stacked per-rank state: leading (size,) axis — exactly the
+            # rank-major layout run_spmd's outputs carry, so the state
+            # round-trips step to step unchanged.
+            cache = jax.tree.map(
+                lambda a: jnp.broadcast_to(a[None], (self._size,)
+                                           + a.shape), cache)
+        self._cache = cache
+        self._tokens = np.zeros((slots,), np.int32)
+        self._pos = np.zeros((slots,), np.int32)
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self._queue: deque = deque()
+        self._results: Dict[Any, np.ndarray] = {}
+        self._known_rids = set()
+        self._next_rid = 0
+        self.slot_log: List[tuple] = []   # (rid, slot) admission history
+        self.stats = _prof._register_serve_stats(_prof.ServeStats())
+
+    # ------------------------------------------------------------- traced
+
+    @staticmethod
+    def _rank_slice(stacked):
+        """This rank's leaves off a stacked (size, ...) state tree."""
+        rank = jnp.asarray(COMM_WORLD.rank)
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, rank, 0,
+                                                   keepdims=False),
+            stacked)
+
+    def _traced_step(self, shards, cache, tokens, pos, active):
+        """Mode A decode step: slice this rank's shard/cache state off
+        the stacked leading axis, decode, return (logits, local cache)
+        — run_spmd re-stacks the per-rank outputs into the state
+        layout."""
+        return _kv.decode_step_tp(
+            self.cfg, self._rank_slice(shards),
+            self._rank_slice(cache), tokens, pos, COMM_WORLD,
+            overlap=self.serve_cfg.overlap,
+            algorithm=self.serve_cfg.algorithm, active=active)
+
+    def _traced_prefill(self, shards, prompt):
+        comm = COMM_WORLD
+        cache = _kv.init_kv_cache_tp(self.cfg, 1, comm.size, self._dtype,
+                                     poison=False)
+        return _kv.prefill_tp(self.cfg, self._rank_slice(shards), cache,
+                              prompt, comm)
+
+    # -------------------------------------------------------------- public
+
+    def submit(self, prompt, *, rid=None, max_new: Optional[int] = None,
+               key=None):
+        """Queue one request; returns its id.  Validates the
+        ``generate()`` preconditions (budget fits ``max_seq``, sampled
+        decoding needs a key) and applies queue backpressure
+        (:class:`QueueFullError` past ``queue_limit``)."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                f"prompt must be a non-empty 1-d token array; got shape "
+                f"{prompt.shape}")
+        budget = int(max_new if max_new is not None
+                     else self.serve_cfg.max_new)
+        if budget < 1:
+            raise ValueError(f"max_new must be >= 1, got {budget}")
+        if prompt.size + budget > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt {prompt.size} + n_new {budget} exceeds max_seq "
+                f"{self.cfg.max_seq}")
+        if self.serve_cfg.temperature > 0 and key is None:
+            raise ValueError("temperature > 0 requires a PRNG `key`")
+        limit = self.serve_cfg.queue_limit
+        if limit is not None and \
+                len(self._queue) >= limit + len(self._free_slots()):
+            # The bound is on requests the engine cannot yet absorb:
+            # free slots count as immediate capacity (the next step
+            # admits into them), everything beyond slots + limit is
+            # rejected — the queue stays bounded even before the first
+            # step runs.
+            self.stats.count("rejected")
+            raise QueueFullError(
+                f"serve queue full ({len(self._queue)} waiting, "
+                f"{len(self._free_slots())} free of "
+                f"{self.serve_cfg.slots} slots; queue_limit={limit})")
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        elif rid in self._known_rids:
+            # A duplicate would silently merge two requests' events,
+            # spans and results under one key.
+            raise ValueError(
+                f"request id {rid!r} is already in use by a queued, "
+                "in-flight, or finished request of this engine")
+        self._known_rids.add(rid)
+        self._queue.append(Request(rid=rid, prompt=prompt,
+                                   max_new=budget, key=key))
+        self.stats.mark(rid, "submitted")
+        return rid
+
+    def pending(self) -> int:
+        """Requests not yet finished (queued + occupying slots)."""
+        return len(self._queue) + sum(
+            r is not None for r in self._slot_req)
+
+    def occupancy(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    def _free_slots(self) -> List[int]:
+        return [j for j, r in enumerate(self._slot_req) if r is None]
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _select(self, req: Request, logits_row) -> int:
+        """One decoding choice for one request — ``generate()``'s exact
+        key discipline: split, then select with the subkey (greedy
+        ignores the key but the stream advances identically)."""
+        if req.key is None:
+            req.key = jax.random.PRNGKey(0)   # unused on greedy path
+        req.key, sub = jax.random.split(req.key)
+        tok = select_token(jnp.asarray(logits_row)[None, :], sub,
+                           self.serve_cfg.temperature,
+                           self.serve_cfg.top_k, jnp.int32)
+        return int(np.asarray(tok)[0])
+
+    def _admit(self, events: dict) -> None:
+        """Fill free slots from the queue; admission events (including
+        a first token that already finishes the request — ``max_new=1``
+        or an immediate EOS) land in ``events`` so the step-event
+        surface never drops a token or a completion."""
+        chooser = POLICIES[self.serve_cfg.policy]
+        while self._queue and self._free_slots():
+            req = self._queue[chooser(self._queue)]
+            self._queue.remove(req)
+            prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+            if self._spmd:
+                logits, rows = self._prefill_call(self._shards, prompt)
+                logits_row = np.asarray(logits[0][0])
+            else:
+                cache1 = _kv.init_kv_cache_tp(
+                    self.cfg, 1, self._size, self._dtype, poison=False)
+                logits, rows = _kv.prefill_tp(
+                    self.cfg, self._shards, cache1, prompt, self._comm)
+                logits_row = np.asarray(logits[0])
+            self.stats.mark(req.rid, "admitted")
+            self.stats.count("admitted")
+            tok = self._select(req, logits_row)
+            req.emitted.append(tok)
+            self.stats.mark(req.rid, "first_token")
+            events["admitted"].append(req.rid)
+            events["emitted"].setdefault(req.rid, []).append(tok)
+            if req.finished(self.serve_cfg.eos):
+                # Finished at admission (max_new=1 / immediate EOS):
+                # it never occupied a slot, so no eviction counts —
+                # but the event surface reports it like any other
+                # completion.
+                events["finished"].append(req.rid)
+                self._finish(req)
+                continue
+            j = self._free_slots()[0]
+            self.slot_log.append((req.rid, j))
+            if self._spmd:
+                self._cache = jax.tree.map(
+                    lambda s, r: s.at[:, j].set(r[:, 0]),
+                    self._cache, rows)
+            else:
+                self._cache = jax.tree.map(
+                    lambda s, r: s.at[j].set(r[0]), self._cache, rows)
+            self._slot_req[j] = req
+            self._tokens[j] = tok
+            self._pos[j] = int(req.prompt.size)
+
+    def _finish(self, req: Request) -> None:
+        self._results[req.rid] = np.concatenate(
+            [np.asarray(req.prompt, np.int64),
+             np.asarray(req.emitted, np.int64)])
+        self.stats.mark(req.rid, "finished")
+        self.stats.count("finished")
+
+    def _evict(self, j: int) -> None:
+        req = self._slot_req[j]
+        self._slot_req[j] = None
+        self._tokens[j] = 0
+        self._pos[j] = 0
+        # Re-poison the freed slot's cache rows: stale K/V must be
+        # provably inert, not accidentally plausible.
+        if jnp.issubdtype(jnp.dtype(self._dtype), jnp.floating):
+            if self._spmd:
+                self._cache = jax.tree.map(
+                    lambda s: s.at[:, j].set(jnp.nan), self._cache)
+            else:
+                self._cache = jax.tree.map(
+                    lambda s: s.at[j].set(jnp.nan), self._cache)
+        self.stats.count("evicted")
+        self._finish(req)
+
+    def step(self) -> dict:
+        """Admissions, then ONE decode step over the slot table, then
+        evictions.  Returns ``{"admitted": [...], "emitted": {rid:
+        [tokens]}, "finished": [rid...]}`` — admission first-tokens and
+        admission-time completions (``max_new=1``, immediate EOS) are
+        reported through the same surface as decode events (a freshly
+        admitted request can emit TWO tokens in one step: its prefill
+        first-token and its first decode token), so a front-end
+        driving replies off ``step()`` never misses one.
+        Finished requests' full sequences accumulate for
+        :meth:`results`/:meth:`run`."""
+        events = {"admitted": [], "emitted": {}, "finished": []}
+        self._admit(events)
+        active = [j for j, r in enumerate(self._slot_req)
+                  if r is not None]
+        if not active:
+            return events
+        live = np.asarray([r is not None for r in self._slot_req])
+        if self._spmd:
+            logits, self._cache = self._step_call(
+                self._shards, self._cache, jnp.asarray(self._tokens),
+                jnp.asarray(self._pos), jnp.asarray(live))
+            table = np.asarray(logits[0])
+        else:
+            logits, self._cache = _kv.decode_step_tp(
+                self.cfg, self._shards, self._cache,
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                self._comm, overlap=self.serve_cfg.overlap,
+                algorithm=self.serve_cfg.algorithm,
+                active=jnp.asarray(live))
+            table = np.asarray(logits)
+        self.stats.tick(len(active), self.serve_cfg.slots)
+        for j in active:
+            req = self._slot_req[j]
+            tok = self._select(req, table[j])
+            req.emitted.append(tok)
+            events["emitted"].setdefault(req.rid, []).append(tok)
+            self.stats.count("decode_tokens")
+            self._pos[j] += 1
+            self._tokens[j] = tok
+            if req.finished(self.serve_cfg.eos):
+                events["finished"].append(req.rid)
+                self._evict(j)
+        return events
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[Any, np.ndarray]:
+        """Drive :meth:`step` until every submitted request finished
+        (or ``max_steps``); returns ``{rid: full token sequence}`` —
+        prompt + emitted, the ``generate()`` output shape."""
+        steps = 0
+        while self.pending():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return dict(self._results)
+
+    def results(self) -> Dict[Any, np.ndarray]:
+        return dict(self._results)
+
+    def pop_results(self) -> Dict[Any, np.ndarray]:
+        """Retrieve-and-drop every finished result, releasing its
+        request id and memory — the steady-state serving API: a
+        long-lived engine that never pops grows its result table (and
+        id ledger) linearly with requests served.  A popped rid may be
+        reused by a later :meth:`submit`."""
+        out, self._results = self._results, {}
+        self._known_rids.difference_update(out)
+        return out
+
+    # ------------------------------------------------------------- census
+
+    def lower_step(self):
+        """The lowered (Mode A) decode-step program over the CURRENT
+        slot-table state — the deterministic census surface:
+        ``overlap.scheduled_exposure(engine.lower_step())`` and the
+        latency-tier span assertions read it (``make serve-smoke``,
+        ``bench._bench_serve``)."""
+        if not self._spmd:
+            raise CommError(
+                "lower_step censuses the compiled SPMD decode program; "
+                "construct the engine with spmd=True")
+        live = jnp.asarray(
+            [r is not None for r in self._slot_req])
+        return jax.jit(self._step_call).lower(
+            self._shards, self._cache, jnp.asarray(self._tokens),
+            jnp.asarray(self._pos), live)

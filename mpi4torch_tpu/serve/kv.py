@@ -1,0 +1,440 @@
+"""TP-sharded KV-cache decode: the serving compute core.
+
+The training stack shards *gradients* (fuse/zero) — serving shards the
+**KV cache**, the HBM-resident state that bounds decode batch size.
+Heads are sharded over the communicator following the
+:mod:`mpi4torch_tpu.parallel.tp` conventions (each rank owns
+``n_heads / size`` query heads and ``kv_heads / size`` KV heads
+end-to-end, validated by :func:`parallel.tp.shard_heads`), so per-head
+attention never crosses ranks and each layer costs exactly TWO
+collectives: the row-parallel output projection's Allreduce and the
+row-parallel FFN Allreduce — the Megatron decode schedule.
+
+Three design rules, all serving-specific:
+
+* **per-slot positions** — :func:`decode_step_tp` takes ``pos`` as a
+  ``(slots,)`` vector: every slot of the continuous batch sits at its
+  own sequence position.  The scalar-``pos`` machinery of
+  ``models/transformer.decode_step`` generalizes via
+  :func:`~mpi4torch_tpu.ops.ragged.position_onehot` write masks (cache
+  update), batched rope rotation, and per-row causal frontiers in the
+  attention mask (ops/flash.py) — static shapes throughout, ONE
+  compiled step program for any mix of positions.
+* **decode comm rides the overlap scheduler** — each per-layer
+  Allreduce is issued through
+  :func:`~mpi4torch_tpu.overlap.overlap_split_allreduce` (windowed
+  split-phase chunk buckets, >= 2 transfers in flight) when the overlap
+  policy is on, the blocking facade ``Allreduce`` when off; the
+  ``ServeDecode.bucket<i>of<n>`` spans make the schedule censusable by
+  :func:`~mpi4torch_tpu.overlap.scheduled_exposure`.
+* **latency-tier selection** — decode payloads are ``slots x d_model``
+  elements, a few KiB: with ``algorithm=None`` the tune selector keys
+  on the real (chunk) message size and lands in the latency tier
+  (rhd/tree) below the measured crossover instead of inheriting
+  training's bandwidth-tier defaults; the ``select_auto`` latency-tier
+  guard keeps aliased bandwidth winners out (ISSUE 10 satellite).
+
+Everything here is **inference-only** (no VJPs — serving never
+differentiates) and backend-portable: the same functions run eagerly
+inside ``run_ranks`` rank threads (Mode B) and traced under ``run_spmd``
+(Mode A), bit-identical under ``deterministic_mode``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import config as _config
+from ..constants import MPI_SUM
+from ..models.transformer import TransformerConfig, _norm, _rope_rotate
+from ..ops.flash import flash_attention, flash_block_attention
+from ..ops.ragged import position_onehot
+from ..overlap import overlap_split_allreduce, resolve_overlap
+from ..parallel.tp import shard_axis, shard_heads
+from ..runtime import CommError
+from ..utils.profiling import bucket_scope, serve_step_scope
+
+__all__ = [
+    "validate_tp",
+    "shard_params_tp",
+    "init_kv_cache_tp",
+    "prefill_tp",
+    "decode_step_tp",
+    "admit_zero3",
+]
+
+
+def validate_tp(cfg: TransformerConfig, size: int) -> None:
+    """Serving TP shardability of a model config over ``size`` ranks:
+    whole q heads, whole KV heads, and an FFN hidden divisible per rank.
+    MoE configs are refused — expert-parallel decode routes through
+    ``parallel/moe.py``'s Alltoall, a different serving schedule than
+    the dense TP path this subsystem ships."""
+    if cfg.n_experts > 0:
+        raise CommError(
+            "serve: MoE configs (n_experts > 0) are not supported by the "
+            "dense TP decode path — expert-parallel serving needs the "
+            "Alltoall routing schedule")
+    if cfg.n_heads % size != 0 or cfg.kv_heads % size != 0:
+        raise CommError(
+            f"serve: n_heads={cfg.n_heads} and kv_heads={cfg.kv_heads} "
+            f"must both divide into {size} TP ranks (whole-head "
+            "sharding)")
+    if cfg.d_ff % size != 0:
+        raise CommError(
+            f"serve: d_ff={cfg.d_ff} not divisible by world size {size}")
+
+
+def _shard_wqkv(cfg: TransformerConfig, comm, wqkv):
+    """This rank's column slice of the fused qkv projection — THE one
+    place the interleaved q/k/v head-block layout is cut (both
+    :func:`shard_params_tp` and :func:`admit_zero3`'s post-pass slice
+    through here, so the layout rule cannot drift between them): the
+    three head-block ranges each shard by whole heads and re-fuse as
+    ``[q_r | k_r | v_r]`` — still one matmul per layer."""
+    h, h_kv = cfg.n_heads, cfg.kv_heads
+    hd = cfg.d_model // h
+    q = wqkv[:, :h * hd]
+    k = wqkv[:, h * hd:(h + h_kv) * hd]
+    v = wqkv[:, (h + h_kv) * hd:]
+    return jnp.concatenate([shard_heads(comm, q, h, 1),
+                            shard_heads(comm, k, h_kv, 1),
+                            shard_heads(comm, v, h_kv, 1)], axis=1)
+
+
+def _shard_swiglu_w1(cfg: TransformerConfig, comm, w1):
+    """This rank's column slice of the fused swiglu gate|up projection
+    (each half sharded separately so the rank keeps MATCHING gate/up
+    slices); shared by both shard paths like :func:`_shard_wqkv`."""
+    gate, up = w1[:, :cfg.d_ff], w1[:, cfg.d_ff:]
+    return jnp.concatenate(
+        [shard_axis(comm, gate, 1), shard_axis(comm, up, 1)], axis=1)
+
+
+def shard_params_tp(cfg: TransformerConfig, params, comm):
+    """This rank's tensor-parallel serving shard of a full parameter
+    tree (trace-safe: works with a traced SPMD rank).
+
+    Layout (the :mod:`..parallel.tp` column/row pairing per sub-layer):
+
+    * ``wqkv`` — the fused projection splits into its q/k/v head-block
+      ranges, each column-sharded by WHOLE heads
+      (:func:`parallel.tp.shard_heads`), re-fused as this rank's
+      ``[q_r | k_r | v_r]`` slab — one matmul per layer, like the dense
+      path;
+    * ``wo`` — row-sharded by the same q-head blocks (the row-parallel
+      half whose Allreduce is decode collective site 0 of the layer);
+    * ``w1`` — column-sharded (swiglu's fused gate|up halves sharded
+      separately so each rank keeps matching gate/up slices); ``w2`` —
+    * row-sharded (decode collective site 1);
+    * embeddings, norms, positional table, unembedding — replicated
+      (logits are computed fully on every rank: rank-identical logits
+      are what make the host-side sampling loop SPMD-consistent).
+
+    At ``size == 1`` every shard is the full matrix — the local serving
+    path is the same code with identity collectives."""
+    size = comm.size
+    validate_tp(cfg, size)
+
+    def block_shard(blk):
+        out = {"ln1": blk["ln1"], "ln2": blk["ln2"],
+               "wqkv": _shard_wqkv(cfg, comm, blk["wqkv"]),
+               "wo": shard_heads(comm, blk["wo"], cfg.n_heads, 0)}
+        if cfg.ffn == "swiglu":
+            out["w1"] = _shard_swiglu_w1(cfg, comm, blk["w1"])
+        else:
+            out["w1"] = shard_axis(comm, blk["w1"], 1)
+        out["w2"] = shard_axis(comm, blk["w2"], 0)
+        return out
+
+    shards = {
+        "embed": params["embed"],
+        "ln_f": params["ln_f"],
+        "unembed": params["unembed"],
+        "blocks": [block_shard(blk) for blk in params["blocks"]],
+    }
+    if "pos" in params:
+        shards["pos"] = params["pos"]
+    return shards
+
+
+def init_kv_cache_tp(cfg: TransformerConfig, slots: int, size: int,
+                     dtype=jnp.float32, poison: bool = False):
+    """Per-layer TP-sharded slot-table KV cache:
+    ``(slots, max_seq, kv_heads / size, head_dim)`` per rank — the GQA
+    saving and the TP saving multiply, which is the whole point of
+    sharding the serving cache.
+
+    ``poison=True`` fills the buffers with NaN — the engine's free-slot
+    discipline: a poisoned slot that ever leaked into a live slot's
+    logits would be caught immediately (all per-slot compute is
+    row-local, and tests assert the inertness), while admission
+    overwrites the whole slot row so live slots never see the poison."""
+    hd = cfg.d_model // cfg.n_heads
+    shape = (slots, cfg.max_seq, cfg.kv_heads // size, hd)
+    fill = jnp.nan if poison and jnp.issubdtype(dtype, jnp.floating) \
+        else 0
+    buf = jnp.full(shape, fill, dtype)
+    return [{"k": buf, "v": buf} for _ in range(cfg.n_layers)]
+
+
+def _tp_size(cfg: TransformerConfig, shards) -> int:
+    """The TP world size a shard tree was built for, read off the
+    output projection's row count (``h_local * head_dim``) — so the
+    compute functions need no communicator to agree with their
+    shards."""
+    hd = cfg.d_model // cfg.n_heads
+    h_local = shards["blocks"][0]["wo"].shape[0] // hd
+    return cfg.n_heads // h_local
+
+
+def _split_qkv_local(cfg: TransformerConfig, blk, y, positions, size):
+    """This rank's q/k/v head slabs from its ``[q_r | k_r | v_r]`` fused
+    projection shard — the TP-local mirror of
+    ``models/transformer._split_qkv`` (same fused-matmul shape, local
+    head counts).  ``positions`` may be ``(s,)`` or ``(b, s)``
+    (per-slot decode positions; the batched rope branch)."""
+    b, s = y.shape[0], y.shape[1]
+    h_loc = cfg.n_heads // size
+    hkv_loc = cfg.kv_heads // size
+    hd = cfg.d_model // cfg.n_heads
+    qkv = y @ blk["wqkv"]
+    q = qkv[..., :h_loc * hd].reshape(b, s, h_loc, hd)
+    k = qkv[..., h_loc * hd:(h_loc + hkv_loc) * hd].reshape(
+        b, s, hkv_loc, hd)
+    v = qkv[..., (h_loc + hkv_loc) * hd:].reshape(b, s, hkv_loc, hd)
+    if cfg.rope:
+        q = _rope_rotate(cfg, q, positions)
+        k = _rope_rotate(cfg, k, positions)
+    return q, k, v
+
+
+def _decode_allreduce(comm, x, *, site: int, nsites: int, overlap,
+                      algorithm=None):
+    """One decode collective site: the row-parallel partial-sum
+    Allreduce, scheduled per the overlap policy.  ``overlap`` truthy →
+    the windowed split-phase chunk window
+    (:func:`~mpi4torch_tpu.overlap.overlap_split_allreduce`, bucket
+    labels globally numbered over the step's ``nsites`` sites); falsy →
+    the blocking facade op under a plain (exposed-by-construction)
+    bucket span, the censusable baseline.  Always exact
+    (``compression=False`` — decode activations are forward values, the
+    house rule that keeps a gradient-codec scope off them)."""
+    if comm is None:
+        return x
+    if overlap:
+        k = _config.serve_decode_buckets()
+        return overlap_split_allreduce(
+            comm, x, MPI_SUM, nsplits=k, index_base=site * k,
+            index_total=nsites * k, op_name="ServeDecode",
+            algorithm=algorithm)
+    with bucket_scope("ServeDecode", site, nsites):
+        return comm.Allreduce(x, MPI_SUM, compression=False,
+                              algorithm=algorithm)
+
+
+def _ffn_local(cfg: TransformerConfig, blk, y):
+    """The TP-local FFN partial product (pre-Allreduce)."""
+    if cfg.ffn == "swiglu":
+        gate_up = y @ blk["w1"]
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        return (jax.nn.silu(gate) * up) @ blk["w2"]
+    return jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+
+
+def prefill_tp(cfg: TransformerConfig, shards, cache, prompt, comm=None):
+    """TP prefill: populate this rank's KV-cache shard rows from a whole
+    prompt in one batched pass and return ``(last_logits, new_cache)``
+    — the serving mirror of ``models/transformer.prefill`` (same op
+    sequence per rank; one blocking Allreduce per row-parallel half —
+    prefill is the compute-bound phase, so its collectives stay on the
+    blocking path and out of the decode exposure census)."""
+    b, p_len = prompt.shape
+    size = _tp_size(cfg, shards)
+    x = shards["embed"][prompt]
+    if not cfg.rope:
+        x = x + shards["pos"][None, :p_len]
+    positions = jnp.arange(p_len, dtype=jnp.int32)
+    new_cache = []
+    with serve_step_scope("prefill"):
+        for blk, c in zip(shards["blocks"], cache):
+            y = _norm(cfg, x, blk["ln1"])
+            q, k, v = _split_qkv_local(cfg, blk, y, positions, size)
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                c["k"], k.astype(c["k"].dtype), 0, 1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                c["v"], v.astype(c["v"].dtype), 0, 1)
+            new_cache.append({"k": ck, "v": cv})
+            o = flash_attention(q, k, v, causal=True,
+                                window=cfg.attn_window)
+            o_part = o.reshape(b, p_len, -1) @ blk["wo"]
+            if comm is not None:
+                o_part = comm.Allreduce(o_part, MPI_SUM,
+                                        compression=False)
+            x = x + o_part.astype(x.dtype)
+            ff = _ffn_local(cfg, blk, _norm(cfg, x, blk["ln2"]))
+            if comm is not None:
+                ff = comm.Allreduce(ff, MPI_SUM, compression=False)
+            x = x + ff.astype(x.dtype)
+        x = _norm(cfg, x, shards["ln_f"])
+        return x[:, -1] @ shards["unembed"], new_cache
+
+
+def decode_step_tp(cfg: TransformerConfig, shards, cache, tokens, pos,
+                   comm=None, *, overlap=None,
+                   algorithm: Optional[str] = None, active=None):
+    """One continuous-batching decode step over the whole slot table:
+    logits for ``tokens`` ``(slots,)``, each slot at its OWN position
+    ``pos[slot]`` ``(slots,)``, updating this rank's KV-cache shard.
+    Returns ``(logits (slots, vocab), new_cache)``.
+
+    Per slot this is exactly ``models/transformer.decode_step``'s math
+    (teacher-forcing equivalent to the training forward), vectorized
+    over per-slot positions: the cache write is a
+    :func:`~mpi4torch_tpu.ops.ragged.position_onehot` masked ``where``
+    (same written bits as the scalar ``dynamic_update_slice``), rope
+    rotates with per-row angles, and attention masks per-row causal /
+    sliding-window frontiers over the full static ``max_seq`` buffer —
+    no length bookkeeping, no retrace as traffic churns.  Free slots
+    (whatever ``pos``/``tokens`` they carry) compute row-local garbage
+    that never touches live rows: every op is row-wise and the TP
+    collectives reduce over RANKS, not slots.
+
+    ``overlap``: ``None`` defers to ``config.default_overlap()``;
+    truthy rides each of the ``2 * n_layers`` collective sites through
+    the windowed split-phase chunk window (``scheduled_exposure``
+    strictly < 1.0); ``False`` pins the blocking baseline (censuses
+    1.0).  ``algorithm=None`` lets the tune selector key on the real
+    chunk sizes — the latency tier for per-token traffic.
+
+    ``active`` (``(slots,)`` bool/int, optional) zeroes the FREE slots'
+    rows of every collective payload before it touches the wire: a
+    poisoned free slot's NaN partial sums otherwise ride the allreduce
+    and trip PR 7's finite guard (``config.comm_finite_guard``) with a
+    false corruption attribution on healthy ranks.  Live rows pass
+    through the mask bit-identically (``where`` selects, never
+    scales), so the parity contract is untouched; the engine always
+    passes its slot-occupancy mask.
+
+    Inference-only: no VJP (serving never differentiates), and the
+    sliding-window case attends the full buffer with the window mask
+    (the position-tracking bucket slice of ``decode_step`` is a
+    single-sequence optimization; per-slot gathers would re-shuffle the
+    cache every step for a smoke-scale win)."""
+    slots = tokens.shape[0]
+    pos = jnp.asarray(pos, jnp.int32)
+    size = _tp_size(cfg, shards)
+    ov = resolve_overlap(overlap)
+    nsites = 2 * len(shards["blocks"])
+    live = None if active is None \
+        else jnp.asarray(active).astype(bool)[:, None]
+
+    def guard_rows(payload):
+        # Free-slot rows never reach the wire carrying poison.
+        if live is None:
+            return payload
+        return jnp.where(live, payload, jnp.zeros((), payload.dtype))
+
+    with serve_step_scope("decode_step"):
+        x = shards["embed"][tokens]
+        if not cfg.rope:
+            x = x + jnp.take(shards["pos"], pos, axis=0)
+        site = 0
+        new_cache = []
+        for blk, c in zip(shards["blocks"], cache):
+            y = _norm(cfg, x, blk["ln1"])
+            q, k_new, v_new = _split_qkv_local(
+                cfg, blk, y[:, None, :], pos[:, None], size)
+            write = position_onehot(pos, cfg.max_seq) != 0
+            wmask = write[:, :, None, None]
+            ck = jnp.where(wmask, k_new.astype(c["k"].dtype), c["k"])
+            cv = jnp.where(wmask, v_new.astype(c["v"].dtype), c["v"])
+            new_cache.append({"k": ck, "v": cv})
+            o, _ = flash_block_attention(
+                q, ck, cv, causal=True, q_offset=pos, kv_offset=0,
+                window=cfg.attn_window, impl="jnp")
+            o_part = o.reshape(slots, -1).astype(x.dtype) @ blk["wo"]
+            attn = _decode_allreduce(comm, guard_rows(o_part), site=site,
+                                     nsites=nsites, overlap=ov,
+                                     algorithm=algorithm)
+            site += 1
+            x = x + attn.astype(x.dtype)
+            ff = _ffn_local(cfg, blk, _norm(cfg, x, blk["ln2"]))
+            ff = _decode_allreduce(comm, guard_rows(ff), site=site,
+                                   nsites=nsites,
+                                   overlap=ov, algorithm=algorithm)
+            site += 1
+            x = x + ff.astype(x.dtype)
+        x = _norm(cfg, x, shards["ln_f"])
+        return x @ shards["unembed"], new_cache
+
+
+def admit_zero3(cfg: TransformerConfig, comm, p_shards, template, *,
+                dtype=None, strategy=None):
+    """Admit a ZeRO-3-trained checkpoint into serving TP shards — the
+    train→serve boundary recipe, on the planned
+    :meth:`~mpi4torch_tpu.MPI_Communicator.Reshard` path
+    (``parallel.zero.zero3_to_tp``), never the
+    gather-everything-everywhere default.
+
+    Per-leaf routing: ``wo``/``w2`` take the row-shard Layout and
+    ``w1`` (gelu) the column-shard Layout — each ONE planned
+    all-to-all-class exchange, ``O(shard)`` peak; ``wqkv`` (its q/k/v
+    head blocks interleave per rank — not an axis-contiguous shard the
+    chunk-grid planner can express) and swiglu's fused ``w1`` ride the
+    replicated Layout (the documented planned-gather leg) and are
+    column-sliced locally; embeddings/norms/unembedding replicate.
+    ``dtype`` is the serving-precision override (bf16 shards under f32
+    training state), applied by ``zero3_to_tp`` after the exchange.
+
+    Returns the :func:`shard_params_tp`-layout serve tree, bitwise
+    equal to ``shard_params_tp(cfg, zero3_params(...), comm)`` — the
+    redistribution moves bits, never rounds them (pre-``dtype``)."""
+    from .. import reshard as _rs
+    from ..parallel.zero import zero3_to_tp
+
+    import re as _re
+
+    size = comm.size
+    validate_tp(cfg, size)
+    row = _rs.Layout((size,), ((0,), ()))
+    col = _rs.Layout((size,), ((), (0,)))
+
+    # Path-routed Layout rules in the reshard/rules.py mold; everything
+    # unmatched — embeddings, positional table, norms, unembedding, and
+    # the head-interleaved fused projections — replicates.
+    rules = [
+        (r"blocks/\d+/wo$", row),
+        (r"blocks/\d+/w2$", row),
+    ]
+    if cfg.ffn != "swiglu":
+        rules.append((r"blocks/\d+/w1$", col))
+
+    def lay_for(path, leaf):
+        shape = jnp.shape(leaf)
+        for pat, lay in rules:
+            if _re.search(pat, path) and len(shape) == len(lay.spec):
+                return lay
+        return _rs.Layout((size,), ((),) * len(shape))
+
+    paths = _rs.tree_paths(template)
+    specs = jax.tree.map(lay_for, paths, template)
+    tp_tree = zero3_to_tp(comm, p_shards, template, specs,
+                          strategy=strategy, dtype=dtype)
+
+    # Local post-pass: the replicated-admitted fused projections take
+    # their head-aligned column slices here (pure slicing — bitwise),
+    # through the SAME layout helpers shard_params_tp cuts with.
+    out_blocks = []
+    for blk in tp_tree["blocks"]:
+        nb = dict(blk)
+        nb["wqkv"] = _shard_wqkv(cfg, comm, blk["wqkv"])
+        if cfg.ffn == "swiglu":
+            nb["w1"] = _shard_swiglu_w1(cfg, comm, blk["w1"])
+        out_blocks.append(nb)
+    out = {k: v for k, v in tp_tree.items() if k != "blocks"}
+    out["blocks"] = out_blocks
+    return out

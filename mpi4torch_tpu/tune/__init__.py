@@ -44,8 +44,8 @@ from typing import Optional
 
 from .. import config as _config
 from ..runtime import CommError
-from .autotuner import (autotune_allreduce, cache_path, clear,
-                        ensure_tuned_allreduce, entry_from_disk,
+from .autotuner import (autotune_allreduce, bucket_nbytes, cache_path,
+                        clear, ensure_tuned_allreduce, entry_from_disk,
                         generation, lookup, lookup_algorithm, make_key,
                         record)
 from .registry import (AlgorithmSpec, available_algorithms, best_group,
@@ -62,6 +62,7 @@ __all__ = [
     "select_auto",
     "codec_algorithms",
     "autotune_allreduce",
+    "bucket_nbytes",
     "ensure_tuned_allreduce",
     "lookup",
     "lookup_algorithm",
@@ -185,9 +186,28 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     # never hijack — or be hijacked by — exact selection.
     winner = lookup_algorithm(collective, dtype, nbytes, nranks,
                               codec=codec)
-    if winner is not None and ok(winner):
-        return winner
     crossover = _config.latency_crossover_bytes()
+    if winner is not None and ok(winner):
+        if (codec is None and crossover is not None
+                and nbytes <= crossover
+                and get_algorithm(winner).bandwidth_optimal):
+            # Latency-tier guard (ISSUE 10 satellite): decode-sized
+            # messages share power-of-two nbytes buckets with training
+            # tail buckets, so a bandwidth-tier winner (bidir/torus)
+            # recorded under such a key must never be applied BELOW the
+            # measured latency crossover — a multipath schedule on a
+            # few-KiB per-token payload pays 2x the latency hops for
+            # bandwidth it cannot use.  The cached winner is voided and
+            # the tier dispatch below decides (latency-optimal winners
+            # and mid-tier ring winners are honored as recorded).
+            # Exact traffic only: decode payloads are always exact
+            # (compression=False), so codec-keyed winners carry no
+            # decode-aliasing hazard — and voiding one would strand a
+            # compressed message on ring, since the latency algorithms
+            # below never pass a codec's declared-algorithm gate.
+            winner = None
+        else:
+            return winner
     if crossover is not None and nbytes <= crossover:
         if ok("rhd"):
             return "rhd"

@@ -66,6 +66,18 @@ def _bucket(nbytes: int) -> int:
     return 1 << (nbytes - 1).bit_length()
 
 
+def bucket_nbytes(nbytes: int) -> int:
+    """Public form of the cache's size-bucket rule: the power-of-two
+    bucket a payload of ``nbytes`` keys into.  Serving's latency report
+    (:func:`mpi4torch_tpu.serve.latency_report`) uses it to show which
+    cache bucket the real decode message sizes share — the aliasing the
+    ``select_auto`` latency-tier guard exists for: a decode-sized key
+    can hold a winner recorded by a training tail bucket of the same
+    power-of-two size, so tier membership, not the cache alone, gates
+    sub-crossover selection."""
+    return _bucket(nbytes)
+
+
 def _platform() -> str:
     import jax
 

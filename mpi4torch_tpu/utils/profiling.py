@@ -24,8 +24,11 @@ host-side XLA execution (the harness smoke path, tests/test_observability).
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 
-__all__ = ["profiler_trace", "bucket_scope"]
+__all__ = ["profiler_trace", "bucket_scope", "serve_step_scope",
+           "ServeStats", "serve_stats", "reset_serve_stats"]
 
 
 def bucket_scope(op: str, index: int, total: int, codec=None, phase=None):
@@ -63,6 +66,163 @@ def bucket_scope(op: str, index: int, total: int, codec=None, phase=None):
                 f"{phase!r}")
         name += f".{phase}"
     return jax.named_scope(name)
+
+
+def serve_step_scope(what: str = "decode_step"):
+    """Named scope ``mpi4torch.serve.<what>`` around one serving-engine
+    phase (:mod:`mpi4torch_tpu.serve`) — the decode-step analogue of
+    :func:`bucket_scope`: the span survives into the StableHLO location
+    table of a lowered engine step, so every decode collective a
+    scheduled-exposure census classifies is attributable to the serving
+    path (its full location reads
+    ``mpi4torch.serve.decode_step/.../mpi4torch.ServeDecode.bucket<i>of
+    <n>.<phase>/...``), and profiler traces separate prefill spans from
+    decode spans per engine step."""
+    import jax
+
+    return jax.named_scope(f"mpi4torch.serve.{what}")
+
+
+class ServeStats:
+    """Serving observability: engine counters + per-request spans.
+
+    Counters (monotonic ints): ``steps`` (decode steps run), ``admitted``
+    / ``evicted`` / ``finished`` / ``rejected`` (request lifecycle),
+    ``decode_tokens`` (tokens emitted by decode steps; prefill's first
+    token counts under ``admitted``), ``occupancy_ticks`` (sum of active
+    slots over steps) and ``slot_ticks`` (slots x steps) — their ratio
+    is the mean slot occupancy, THE continuous-batching utilization
+    number.  Spans (per request id): ``submitted`` -> ``admitted`` ->
+    ``first_token`` -> ``finished`` wall-clock timestamps, from which
+    :meth:`snapshot` derives time-to-first-token and end-to-end
+    latencies.  Thread-safe (Mode B runs one engine per rank thread);
+    engines register here so :func:`serve_stats` aggregates
+    process-wide.  ``evicted`` counts slots freed — a request finishing
+    at admission (max_new=1 / immediate EOS) never occupied one, so
+    ``finished >= evicted``.  Spans are capped at the most recent
+    :data:`SPAN_CAP` requests (counters are O(1) forever; an unbounded
+    span dict would grow with total traffic served)."""
+
+    _COUNTERS = ("steps", "admitted", "evicted", "finished", "rejected",
+                 "decode_tokens", "occupancy_ticks", "slot_ticks")
+    SPAN_CAP = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {k: 0 for k in self._COUNTERS}
+        self.spans = {}
+
+    def reset(self) -> None:
+        """Zero the counters and drop the spans (in place, so an
+        engine holding this object keeps counting from zero)."""
+        with self._lock:
+            for k in list(self.counters):
+                self.counters[k] = 0
+            self.spans.clear()
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def tick(self, active: int, slots: int) -> None:
+        """One decode step over a ``slots``-slot table with ``active``
+        live slots."""
+        with self._lock:
+            self.counters["steps"] += 1
+            self.counters["occupancy_ticks"] += int(active)
+            self.counters["slot_ticks"] += int(slots)
+
+    def mark(self, rid, event: str) -> None:
+        """Record a request-lifecycle timestamp (``submitted`` /
+        ``admitted`` / ``first_token`` / ``finished``); the first
+        occurrence wins, so re-marking is harmless.  Oldest spans are
+        evicted past :data:`SPAN_CAP` (dict order is insertion order)."""
+        with self._lock:
+            span = self.spans.setdefault(rid, {})
+            span.setdefault(event, time.perf_counter())
+            while len(self.spans) > self.SPAN_CAP:
+                self.spans.pop(next(iter(self.spans)))
+
+    def snapshot(self) -> dict:
+        """Counters + derived occupancy and latency aggregates."""
+        with self._lock:
+            counters = dict(self.counters)
+            spans = {rid: dict(s) for rid, s in self.spans.items()}
+        ttft = [s["first_token"] - s["submitted"] for s in spans.values()
+                if "first_token" in s and "submitted" in s]
+        e2e = [s["finished"] - s["submitted"] for s in spans.values()
+               if "finished" in s and "submitted" in s]
+        out = dict(counters)
+        out["occupancy"] = (
+            round(counters["occupancy_ticks"] / counters["slot_ticks"], 4)
+            if counters["slot_ticks"] else None)
+        out["n_requests_tracked"] = len(spans)
+        if ttft:
+            out["ttft_s"] = {"mean": sum(ttft) / len(ttft),
+                             "max": max(ttft)}
+        if e2e:
+            out["e2e_s"] = {"mean": sum(e2e) / len(e2e), "max": max(e2e)}
+        return out
+
+
+# Weak references: an engine holds the only strong reference to its
+# ServeStats, so a discarded engine drops out of the aggregate (and out
+# of memory) instead of being summed forever by an append-only list.
+_serve_registry = []
+_serve_registry_lock = threading.Lock()
+
+
+def _register_serve_stats(stats: ServeStats) -> ServeStats:
+    import weakref
+
+    with _serve_registry_lock:
+        _serve_registry.append(weakref.ref(stats))
+    return stats
+
+
+def _live_serve_stats():
+    with _serve_registry_lock:
+        live, keep = [], []
+        for ref in _serve_registry:
+            obj = ref()
+            if obj is not None:
+                live.append(obj)
+                keep.append(ref)
+        _serve_registry[:] = keep   # prune dead engines' slots
+    return live
+
+
+def serve_stats() -> dict:
+    """Process-wide aggregate of every LIVE engine's
+    :class:`ServeStats` (``mpi4torch_tpu.serve.stats()`` re-exports
+    this; engines register weakly, so a garbage-collected engine
+    leaves the aggregate).  Counters sum across engines — under the
+    eager thread-SPMD runtime each rank thread runs its own engine, so
+    counts there are ``nranks`` x the logical traffic (each rank
+    really did run every step)."""
+    engines = _live_serve_stats()
+    agg = {k: 0 for k in ServeStats._COUNTERS}
+    snaps = [e.snapshot() for e in engines]
+    for snap in snaps:
+        for k in agg:
+            agg[k] += snap.get(k, 0)
+    agg["n_engines"] = len(engines)
+    agg["occupancy"] = (round(agg["occupancy_ticks"] / agg["slot_ticks"], 4)
+                        if agg["slot_ticks"] else None)
+    return agg
+
+
+def reset_serve_stats() -> None:
+    """Zero every live engine's counters/spans IN PLACE and empty the
+    registry (test/bench isolation).  Engines constructed before the
+    reset keep counting on their own (now zeroed) ``stats`` object but
+    drop out of the process aggregate — a reset mid-flight is a
+    bookkeeping cut, not an engine restart."""
+    engines = _live_serve_stats()
+    with _serve_registry_lock:
+        _serve_registry.clear()
+    for e in engines:
+        e.reset()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,536 @@
+"""mpi4torch_tpu.serve — continuous-batching inference serving
+(ISSUE 10).
+
+Coverage per the acceptance criteria:
+
+* engine-vs-oracle TOKEN parity: the continuously-batched engine emits
+  exactly the tokens of per-request ``models/transformer.generate`` —
+  across admission/eviction churn, on (1,), (4,) and (2,4) worlds,
+  Mode A (run_spmd) and Mode B (run_ranks), greedy AND sampled, under
+  every registered scheduling policy (the matrix parametrizes over
+  :data:`serve.POLICIES`, so a policy registered without parity
+  coverage fails here — the registry-sync guard pins the known set);
+* slot-table semantics: slot reuse after eviction, full-capacity
+  rejection (``QueueFullError``), occupancy/eviction counters, and the
+  NaN-poisoned free-slot inertness proof (poisoned rows never move live
+  rows' logits by a single bit);
+* the deterministic censuses: ``scheduled_exposure`` of the lowered
+  decode step strictly < 1.0 with overlap on (blocking baseline 1.0),
+  and the latency-tier evidence — ``latency_report`` + the resolved
+  ``Allreduce_start.rhd`` span in the lowered program;
+* Mode A/Mode B bitwise parity of ``decode_step_tp`` under
+  ``deterministic_mode``;
+* the ZeRO-3 → TP admission recipe (``admit_zero3`` bitwise equal to
+  the gather-then-slice route, plus the serving-dtype override);
+* fault composition: a ``rank_death`` mid-decode raises an attributed
+  ``RankFailedError`` on every survivor.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import serve, tune
+from mpi4torch_tpu.models import transformer as T
+from mpi4torch_tpu.serve import kv
+
+CFG = T.TransformerConfig(vocab=37, d_model=16, n_heads=4, n_layers=2,
+                          d_ff=32, max_seq=24)
+CFG_GQA = dataclasses.replace(CFG, n_kv_heads=2)
+CFG_ROPE = dataclasses.replace(CFG, rope=True)
+CFG_SWIGLU = dataclasses.replace(CFG, ffn="swiglu")
+
+PROMPTS = [np.array([1, 2, 3]), np.array([4, 5, 6, 7, 8]),
+           np.array([9, 10]), np.array([11, 12, 13, 14])]
+BUDGETS = [6, 4, 5, 3]
+
+
+def _params(cfg, seed=0):
+    return T.init_transformer(jax.random.PRNGKey(seed), cfg,
+                              dtype=jnp.float64)
+
+
+def oracle_tokens(cfg, params, prompt, n_new, eos=None, temperature=0.0,
+                  top_k=0, key=None):
+    out = T.generate(cfg, params, jnp.asarray(prompt, jnp.int32)[None, :],
+                     n_new, dtype=jnp.float64, temperature=temperature,
+                     top_k=top_k, key=key)
+    seq = np.asarray(out[0])
+    if eos is not None:
+        gen = seq[len(prompt):]
+        hits = np.where(gen == eos)[0]
+        if hits.size:
+            seq = seq[:len(prompt) + hits[0] + 1]
+    return seq
+
+
+def drive(eng, keys=None):
+    for i, (p, n) in enumerate(zip(PROMPTS, BUDGETS)):
+        eng.submit(p, max_new=n,
+                   key=None if keys is None else keys[i])
+    return eng.run()
+
+
+def assert_matches_oracle(cfg, params, results, eos=None,
+                          temperature=0.0, top_k=0, keys=None):
+    for i, (p, n) in enumerate(zip(PROMPTS, BUDGETS)):
+        want = oracle_tokens(cfg, params, p, n, eos=eos,
+                             temperature=temperature, top_k=top_k,
+                             key=None if keys is None else keys[i])
+        np.testing.assert_array_equal(np.asarray(results[i]), want)
+
+
+@pytest.fixture(autouse=True)
+def _serve_isolation(tmp_path, monkeypatch):
+    monkeypatch.setenv("MPI4TORCH_TPU_TUNE_CACHE",
+                       str(tmp_path / "tune_cache.json"))
+    tune.clear()
+    serve.reset_stats()
+    yield
+    tune.clear()
+    serve.reset_stats()
+    mpi.config.set_latency_crossover_bytes(None)
+    mpi.config.set_serve_decode_buckets(
+        mpi.config.DEFAULT_SERVE_DECODE_BUCKETS)
+
+
+class TestEngineOracleParity:
+    """Bitwise token parity vs per-request generate(), with slot churn
+    (4 requests through 2 slots: queueing, eviction, slot reuse)."""
+
+    @pytest.mark.parametrize("policy", sorted(serve.POLICIES))
+    @pytest.mark.parametrize("cfg", [CFG, CFG_GQA, CFG_ROPE, CFG_SWIGLU],
+                             ids=["mha", "gqa", "rope", "swiglu"])
+    def test_local_churn_matrix(self, cfg, policy):
+        params = _params(cfg)
+        eng = serve.Engine(cfg, params,
+                           serve.ServeConfig(slots=2, policy=policy))
+        assert_matches_oracle(cfg, params, drive(eng))
+
+    def test_spmd_world4_overlap(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, overlap=True),
+                           spmd=True, nranks=4)
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    def test_spmd_world4_blocking(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, overlap=False),
+                           spmd=True, nranks=4)
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    def test_spmd_mesh_2x4(self):
+        params = _params(CFG)
+        mesh = mpi.device_mesh({"dp": 2, "tp": 4})
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, overlap=True),
+                           spmd=True, mesh=mesh, axis_name="tp")
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    def test_ranks_world4_mode_b(self):
+        params = _params(CFG)
+
+        def fn(rank):
+            eng = serve.Engine(CFG, params,
+                               serve.ServeConfig(slots=2, overlap=True))
+            return drive(eng)
+
+        outs = mpi.run_ranks(fn, 4, timeout=120.0)
+        # Every rank ran the identical host loop: identical results.
+        for r in range(1, 4):
+            for i in range(len(PROMPTS)):
+                np.testing.assert_array_equal(outs[r][i], outs[0][i])
+        assert_matches_oracle(CFG, params, outs[0])
+
+    def test_sampled_parity_local(self):
+        params = _params(CFG)
+        keys = [jax.random.PRNGKey(100 + i) for i in range(len(PROMPTS))]
+        eng = serve.Engine(
+            CFG, params,
+            serve.ServeConfig(slots=2, temperature=0.9, top_k=7))
+        res = drive(eng, keys=keys)
+        assert_matches_oracle(CFG, params, res, temperature=0.9,
+                              top_k=7, keys=keys)
+
+    def test_eos_truncates_and_evicts_early(self):
+        params = _params(CFG)
+        # A naturally-emitted token as EOS: the engine must stop that
+        # request right after it while the others run to budget.
+        probe = oracle_tokens(CFG, params, PROMPTS[0], BUDGETS[0])
+        eos = int(probe[len(PROMPTS[0]) + 1])     # its 2nd generated token
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, eos=eos))
+        res = drive(eng)
+        assert_matches_oracle(CFG, params, res, eos=eos)
+        gen = probe[len(PROMPTS[0]):]
+        first_hit = int(np.where(gen == eos)[0][0])
+        assert len(res[0]) == len(PROMPTS[0]) + first_hit + 1
+        assert res[0][-1] == eos
+        assert len(res[0]) < len(PROMPTS[0]) + BUDGETS[0] + 1
+        assert eng.stats.snapshot()["finished"] == len(PROMPTS)
+
+
+class TestSlotTable:
+    def test_slot_reuse_after_eviction(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=1))
+        eng.submit(PROMPTS[0], max_new=2)
+        eng.submit(PROMPTS[1], max_new=2)
+        eng.run()
+        # One slot, two requests: the second reused slot 0.
+        assert eng.slot_log == [(0, 0), (1, 0)]
+        assert eng.stats.snapshot()["evicted"] == 2
+
+    def test_full_capacity_rejection(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=1, queue_limit=1))
+        eng.submit(PROMPTS[0], max_new=3)
+        eng.step()                       # occupies the single slot
+        eng.submit(PROMPTS[1], max_new=3)   # waits in the queue
+        with pytest.raises(serve.QueueFullError, match="queue full"):
+            eng.submit(PROMPTS[2], max_new=3)
+        assert eng.stats.snapshot()["rejected"] == 1
+        # Draining frees capacity again.
+        eng.run()
+        assert eng.submit(PROMPTS[2], max_new=3) is not None
+
+    def test_queue_bounded_before_first_step(self):
+        """queue_limit must bound the waiting queue even while slots
+        are still free (pre-step burst): capacity = free slots +
+        queue_limit, nothing beyond it."""
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=1, queue_limit=1))
+        eng.submit(PROMPTS[0], max_new=2)    # absorbed by the free slot
+        eng.submit(PROMPTS[1], max_new=2)    # the one queued-waiter
+        with pytest.raises(serve.QueueFullError):
+            eng.submit(PROMPTS[2], max_new=2)
+        # Both accepted requests still serve to completion.
+        res = eng.run()
+        assert set(res) == {0, 1}
+
+    def test_finite_guard_composes_with_poisoned_free_slots(self):
+        """config.comm_finite_guard='raise' (the PR 7 integrity knob)
+        must not false-positive on a partially-occupied engine: free
+        slots' poisoned rows are masked out of every collective payload
+        before it reaches the wire, and live tokens are unchanged."""
+        params = _params(CFG)
+        want = oracle_tokens(CFG, params, PROMPTS[0], 4)
+        mpi.config.set_comm_finite_guard("raise")
+        try:
+            def fn(rank):
+                eng = serve.Engine(CFG, params,
+                                   serve.ServeConfig(slots=3))
+                eng.submit(PROMPTS[0], max_new=4)   # 2 slots stay free
+                return eng.run()
+
+            outs = mpi.run_ranks(fn, 2, timeout=60.0)
+        finally:
+            mpi.config.set_comm_finite_guard("off")
+        np.testing.assert_array_equal(outs[0][0], want)
+
+    def test_admission_finish_reports_through_step_events(self):
+        """A request that finishes at admission (max_new=1, or first
+        token == eos) must surface through step()'s emitted/finished
+        events like any decode-finished request."""
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=2))
+        eng.submit(PROMPTS[0], max_new=1)
+        ev = eng.step()
+        assert ev["admitted"] == [0] and ev["finished"] == [0]
+        assert len(ev["emitted"][0]) == 1
+        np.testing.assert_array_equal(
+            eng.results()[0], oracle_tokens(CFG, params, PROMPTS[0], 1))
+        # A longer request emits TWO tokens on its admission step:
+        # the prefill first-token plus its first decode token.
+        rid = eng.submit(PROMPTS[1], max_new=3)
+        ev = eng.step()
+        assert len(ev["emitted"][rid]) == 2
+
+    def test_duplicate_rid_rejected(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=2))
+        eng.submit(PROMPTS[0], rid="x", max_new=2)
+        with pytest.raises(ValueError, match="already in use"):
+            eng.submit(PROMPTS[1], rid="x", max_new=2)
+        eng.run()
+        # Still taken after finishing — results()['x'] must stay
+        # unambiguous for the engine's lifetime.
+        with pytest.raises(ValueError, match="already in use"):
+            eng.submit(PROMPTS[1], rid="x", max_new=2)
+
+    def test_pop_results_releases_memory_and_rids(self):
+        """The steady-state serving API: pop finished results so a
+        long-lived engine does not grow with total traffic; a popped
+        rid becomes reusable."""
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=2))
+        eng.submit(PROMPTS[0], rid="x", max_new=2)
+        eng.run()
+        popped = eng.pop_results()
+        np.testing.assert_array_equal(
+            popped["x"], oracle_tokens(CFG, params, PROMPTS[0], 2))
+        assert eng.results() == {}
+        # rid released: a second life for "x" serves normally.
+        eng.submit(PROMPTS[1], rid="x", max_new=2)
+        eng.run()
+        np.testing.assert_array_equal(
+            eng.pop_results()["x"],
+            oracle_tokens(CFG, params, PROMPTS[1], 2))
+
+    def test_stats_registry_drops_collected_engines(self):
+        import gc
+
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=1))
+        eng.submit(PROMPTS[0], max_new=2)
+        eng.run()
+        assert serve.stats()["n_engines"] == 1
+        del eng
+        gc.collect()
+        snap = serve.stats()
+        assert snap["n_engines"] == 0 and snap["finished"] == 0
+
+    def test_occupancy_counters(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=4))
+        eng.submit(PROMPTS[0], max_new=3)
+        eng.run()
+        snap = eng.stats.snapshot()
+        assert snap["steps"] == 2            # budget 3 = prefill + 2 decodes
+        assert snap["occupancy"] == 0.25     # 1 of 4 slots live
+        assert snap["decode_tokens"] == 2
+        span = eng.stats.spans[0]
+        assert span["submitted"] <= span["admitted"] \
+            <= span["first_token"] <= span["finished"]
+
+    def test_poisoned_free_slots_are_inert(self):
+        """NaN-poisoned rows must not move a live row's logits by one
+        bit (all per-slot compute is row-local; collectives reduce over
+        ranks, not slots)."""
+        params = _params(CFG)
+        comm = mpi.COMM_WORLD
+        shards = kv.shard_params_tp(CFG, params, comm)
+        tokens = jnp.asarray([5, 0], jnp.int32)
+        pos = jnp.asarray([2, 0], jnp.int32)
+
+        clean = kv.init_kv_cache_tp(CFG, 2, 1, jnp.float64)
+        poisoned = jax.tree.map(lambda a: a.at[1].set(jnp.nan), clean)
+        l_clean, _ = kv.decode_step_tp(CFG, shards, clean, tokens, pos,
+                                       comm)
+        l_pois, _ = kv.decode_step_tp(CFG, shards, poisoned, tokens, pos,
+                                      comm)
+        np.testing.assert_array_equal(np.asarray(l_clean[0]),
+                                      np.asarray(l_pois[0]))
+        assert np.all(np.isfinite(np.asarray(l_pois[0])))
+
+    def test_submit_validation(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=1))
+        with pytest.raises(ValueError, match="exceeds max_seq"):
+            eng.submit(np.arange(20), max_new=10)
+        with pytest.raises(ValueError, match="non-empty 1-d"):
+            eng.submit(np.zeros((2, 2), np.int32))
+        with pytest.raises(ValueError, match="requires a PRNG"):
+            serve.Engine(CFG, params,
+                         serve.ServeConfig(slots=1, temperature=0.5)) \
+                .submit(PROMPTS[0])
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="unknown scheduling"):
+            serve.ServeConfig(policy="round_robin")
+        with pytest.raises(ValueError, match="slots"):
+            serve.ServeConfig(slots=0)
+        params = _params(CFG)
+        with pytest.raises(mpi.CommError, match="n_heads"):
+            serve.Engine(CFG, params, serve.ServeConfig(slots=1),
+                         spmd=True, nranks=3)
+        moe = dataclasses.replace(CFG, n_experts=2, capacity=8)
+        with pytest.raises(mpi.CommError, match="MoE"):
+            serve.Engine(moe, _params(moe), serve.ServeConfig(slots=1))
+
+
+class TestPolicies:
+    def test_registry_sync_guard(self):
+        """Every registered policy is covered by the parity matrix
+        (which parametrizes over serve.POLICIES); pinning the known set
+        makes registering a policy without extending coverage a loud CI
+        failure rather than a silent gap."""
+        assert set(serve.POLICIES) == {"fcfs", "shortest_first"}
+
+    def test_shortest_first_orders_admissions(self):
+        params = _params(CFG)
+        eng = serve.Engine(
+            CFG, params,
+            serve.ServeConfig(slots=1, policy="shortest_first"))
+        eng.submit(PROMPTS[1], max_new=2)   # len 5
+        eng.submit(PROMPTS[2], max_new=2)   # len 2 — admitted first
+        eng.run()
+        assert [rid for rid, _ in eng.slot_log] == [1, 0]
+
+
+class TestCensusAndLatencyTier:
+    def test_scheduled_exposure_overlap_vs_blocking(self):
+        params = _params(CFG)
+        seen = {}
+        for name, ov in (("overlap", True), ("blocking", False)):
+            eng = serve.Engine(CFG, params,
+                               serve.ServeConfig(slots=2, overlap=ov),
+                               spmd=True, nranks=4)
+            eng.submit(PROMPTS[0], max_new=3)
+            eng.step()
+            seen[name] = mpi.overlap.scheduled_exposure(eng.lower_step())
+        k = mpi.config.serve_decode_buckets()
+        assert seen["overlap"]["n_buckets"] == 2 * CFG.n_layers * k
+        assert seen["overlap"]["exposed_fraction"] < 1.0
+        assert seen["blocking"]["exposed_fraction"] == 1.0
+
+    def test_latency_tier_selection_and_span(self):
+        from mpi4torch_tpu._compat import lowered_text
+
+        params = _params(CFG)
+        mpi.config.set_latency_crossover_bytes(1 << 14)
+        rep = serve.latency_report(CFG, serve.ServeConfig(slots=2), 4,
+                                   jnp.float64)
+        assert rep["latency_tier"] and rep["algorithm"] == "rhd"
+        assert rep["chunk_bytes"] <= rep["latency_crossover_bytes"]
+
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, overlap=True),
+                           spmd=True, nranks=4)
+        eng.submit(PROMPTS[0], max_new=3)
+        eng.step()
+        txt = lowered_text(eng.lower_step(), debug_info=True)
+        # Deterministic evidence off the program itself: the resolved
+        # split-phase scope carries the latency algorithm, and no
+        # bandwidth-tier schedule appears anywhere in the decode step.
+        assert "Allreduce_start.rhd" in txt
+        assert ".bidir" not in txt and ".torus" not in txt
+        # Parity is schedule-independent.
+        res = eng.run()
+        np.testing.assert_array_equal(
+            res[0], oracle_tokens(CFG, params, PROMPTS[0], 3))
+
+    def test_degraded_scope_algorithm_not_claimed_in_span(self):
+        """A scope-default hier whose group rule fails for this
+        communicator degrades to ring inside the backend — the lowered
+        split-phase scope must NOT claim the schedule that never ran
+        (the census reads those spans as evidence)."""
+        import jax as _jax
+
+        comm = mpi.COMM_WORLD
+        mpi.config.set_hier_group_size(5)    # does not divide 4
+        try:
+            with mpi.config.algorithm_scope("hier"):
+                def body(x):
+                    return comm.Wait(comm.Allreduce_start(x, mpi.MPI_SUM))
+                lowered = _jax.jit(mpi.run_spmd(body, nranks=4)).lower(
+                    jnp.ones(64, jnp.float32))
+            from mpi4torch_tpu._compat import lowered_text
+            txt = lowered_text(lowered, debug_info=True)
+            assert "Allreduce_start.hier" not in txt
+            assert "Allreduce_start" in txt
+        finally:
+            mpi.config.set_hier_group_size(None)
+
+    def test_decode_message_bytes(self):
+        scfg = serve.ServeConfig(slots=2)
+        assert serve.decode_message_bytes(CFG, scfg, jnp.float64) \
+            == 2 * CFG.d_model * 8
+
+
+class TestCrossModeBitwise:
+    def test_decode_step_tp_det_mode_a_vs_b(self):
+        params = _params(CFG)
+        tokens = jnp.asarray([3, 5, 7], jnp.int32)
+        pos = jnp.asarray([0, 1, 2], jnp.int32)
+        with mpi.config.deterministic_mode():
+            def step_a(cache, t, p):
+                comm = mpi.COMM_WORLD
+                sh = kv.shard_params_tp(CFG, params, comm)
+                rank = jnp.asarray(comm.rank)
+                local = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, rank, 0, keepdims=False), cache)
+                return kv.decode_step_tp(CFG, sh, local, t, p, comm,
+                                         overlap=True)[0]
+
+            cache0 = kv.init_kv_cache_tp(CFG, 3, 4, jnp.float64)
+            stacked = jax.tree.map(
+                lambda a: jnp.broadcast_to(a[None], (4,) + a.shape),
+                cache0)
+            l_a = mpi.run_spmd(step_a, nranks=4)(stacked, tokens, pos)
+
+            def rank_fn(rank):
+                comm = mpi.COMM_WORLD
+                sh = kv.shard_params_tp(CFG, params, comm)
+                local = kv.init_kv_cache_tp(CFG, 3, 4, jnp.float64)
+                return kv.decode_step_tp(CFG, sh, local, tokens, pos,
+                                         comm, overlap=True)[0]
+
+            outs = mpi.run_ranks(rank_fn, 4, timeout=60.0)
+        for r in range(4):
+            np.testing.assert_array_equal(np.asarray(l_a[r]),
+                                          np.asarray(outs[r]))
+
+
+class TestZero3Admission:
+    def test_admit_zero3_matches_gather_then_slice(self):
+        params = _params(CFG)
+
+        def fn(rank):
+            from mpi4torch_tpu.parallel import zero as Z
+
+            comm = mpi.COMM_WORLD
+            p_shards = Z.zero3_shard_params(comm, params)
+            got = kv.admit_zero3(CFG, comm, p_shards, params)
+            want = kv.shard_params_tp(
+                CFG, Z.zero3_params(comm, p_shards, params), comm)
+            same = jax.tree.map(
+                lambda a, b: bool(jnp.array_equal(a, b)), got, want)
+            return all(jax.tree.leaves(same))
+
+        assert all(mpi.run_ranks(fn, 4, timeout=120.0))
+
+    def test_admit_zero3_serving_dtype_override(self):
+        params = _params(CFG)
+
+        def fn(rank):
+            from mpi4torch_tpu.parallel import zero as Z
+
+            comm = mpi.COMM_WORLD
+            p_shards = Z.zero3_shard_params(comm, params)
+            got = kv.admit_zero3(CFG, comm, p_shards, params,
+                                 dtype=jnp.float32)
+            return all(leaf.dtype == jnp.float32
+                       for leaf in jax.tree.leaves(got))
+
+        assert all(mpi.run_ranks(fn, 2, timeout=120.0))
+
+
+class TestFaultComposition:
+    def test_rank_death_mid_decode_attributed(self):
+        from mpi4torch_tpu import resilience as rz
+
+        params = _params(CFG)
+
+        def fn(rank):
+            eng = serve.Engine(CFG, params, serve.ServeConfig(slots=2))
+            eng.submit(PROMPTS[0], max_new=4)
+            return eng.run()
+
+        # Prefill issues 2*n_layers Allreduce calls; index 2*n_layers is
+        # the FIRST decode-step collective — the fault fires mid-decode.
+        with rz.fault_scope([rz.FaultSpec("rank_death", rank=1,
+                                          op="Allreduce",
+                                          index=2 * CFG.n_layers)]):
+            with pytest.raises(mpi.RankFailedError) as ei:
+                mpi.run_ranks(fn, 2, timeout=20.0)
+        assert ei.value.ranks == frozenset({1})

@@ -560,6 +560,51 @@ class TestSelector:
         assert tune.select_auto(nbytes=1 << 22, dtype=jnp.float32,
                                 nranks=NR) == "ring"
 
+    def test_bandwidth_winner_not_applied_below_latency_crossover(self):
+        """ISSUE 10 satellite: decode-sized messages (a few KiB) share
+        power-of-two nbytes buckets with training tail buckets, so a
+        bandwidth-tier winner cached under such a key must never be
+        applied below the measured latency crossover — per-token serving
+        traffic stays on the latency tier."""
+        tune.record("allreduce", jnp.float32, 2048, NR, "bidir")
+        # Without a measured crossover the cached winner is honored.
+        assert tune.select_auto(nbytes=2048, dtype=jnp.float32,
+                                nranks=NR) == "bidir"
+        # With the crossover above it, the bandwidth winner is voided
+        # and the latency tier decides.
+        mpi.config.set_latency_crossover_bytes(4096)
+        assert tune.select_auto(nbytes=2048, dtype=jnp.float32,
+                                nranks=NR) == "rhd"
+        # A latency-optimal cached winner below the crossover is still
+        # honored as recorded (the guard voids bandwidth winners only)…
+        tune.record("allreduce", jnp.float32, 2048, NR, "tree")
+        assert tune.select_auto(nbytes=2048, dtype=jnp.float32,
+                                nranks=NR) == "tree"
+        # …and above the crossover a bandwidth winner applies normally.
+        tune.record("allreduce", jnp.float32, 1 << 20, NR, "bidir")
+        assert tune.select_auto(nbytes=1 << 20, dtype=jnp.float32,
+                                nranks=NR) == "bidir"
+
+    def test_tier_guard_exempts_codec_keyed_winners(self):
+        """Compressed traffic never shares keys with decode payloads
+        (decode is always exact), so the latency-tier guard must honor
+        a codec-keyed bandwidth winner below the crossover — voiding it
+        would strand the message on ring (the latency algorithms fail
+        the codec's declared-algorithm gate)."""
+        from mpi4torch_tpu.compress import get_codec
+
+        q8 = get_codec("q8")
+        tune.record("allreduce", jnp.float32, 2048, NR, "bidir",
+                    codec=q8)
+        mpi.config.set_latency_crossover_bytes(4096)
+        assert tune.select_auto(nbytes=2048, dtype=jnp.float32,
+                                nranks=NR, codec=q8) == "bidir"
+
+    def test_bucket_nbytes_public_rule(self):
+        assert tune.bucket_nbytes(1) == 1
+        assert tune.bucket_nbytes(3000) == 4096
+        assert tune.bucket_nbytes(4096) == 4096
+
     def test_deterministic_mode_pins_ring(self):
         mpi.config.set_latency_crossover_bytes(4096)
         assert tune.select_auto(nbytes=512, dtype=jnp.float32, nranks=NR,
