@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -104,6 +104,24 @@ serve-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.serve --smoke
+
+# CPU smoke run of the static collective-schedule verifier
+# (mpi4torch_tpu.analyze): the registry-wide lint sweep — every
+# registered (algorithm x codec) Allreduce pair (forward + backward,
+# with each algorithm's declared VJP-symmetry checked), the
+# Bcast_/Reduce_ forms, every reshard strategy, the overlap schedules,
+# and the serve decode step, lowered on the (8,), (3,), (1,) and
+# (2,4) worlds and run through the soundness lints (permute tables are
+# partial permutations, replica groups partition the axis, split-phase
+# start/wait spans pair up) — plus the seeded-defect corpus: mutated
+# schedules (dropped wait, duplicated permute target, non-partitioning
+# group, ...) each of which must be caught BY ITS NAMED LINT.  Exits
+# non-zero on any lint violation, registry drift, or a lint that fails
+# to fire on its mutant.
+analyze-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.analyze --sweep --defects
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -70,6 +70,7 @@ from . import overlap
 from . import resilience
 from . import reshard
 from . import serve
+from . import analyze
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
@@ -119,6 +120,7 @@ __all__ = [
     "resilience",
     "reshard",
     "serve",
+    "analyze",
     "SpmdWaitHandle",
     "FaultPlan",
     "FaultSpec",

@@ -1,0 +1,241 @@
+"""Seeded-defect corpus: mutated schedules proving every lint fires.
+
+A lint that never fires is worse than no lint — it reads as coverage.
+The resilience subsystem solved the same problem for fault injection
+with the fired-fault ledger (a fault cell passes only if its fault
+demonstrably acted); this module applies that discipline to static
+analysis: for every lint in :data:`mpi4torch_tpu.analyze.LINT_NAMES`
+the corpus carries at least one *mutated schedule* — a clean lowered
+program with a targeted defect spliced into its text — and
+:func:`run_defect_corpus` verifies that
+
+1. the clean program lints clean,
+2. the mutant is caught **by the named lint** (not incidentally by
+   another), and
+3. every registered lint catches at least one mutant (the ledger —
+   :func:`defect_ledger_problems`).
+
+The mutations are the static analogues of the runtime failure modes:
+
+* ``dropped-wait`` — a bucket's ``.wait`` span vanishes (the un-waited
+  handle that DeadlockError catches at run time);
+* ``orphan-wait`` — a wait with no start (a completion for a handle
+  nothing issued);
+* ``double-wait`` — a bucket's completion collective duplicated (the
+  BifurcationError double-Wait);
+* ``duplicated-permute-target`` — two sources shipping into one target
+  rank (a silently dropped shard);
+* ``non-partitioning-group`` — a replica group that lists one rank
+  twice and another not at all (a contribution that never merges);
+* ``dropped-backward`` — a "value_and_grad" lowering that contains no
+  backward collectives (AD transparency silently lost).
+
+Both the ``make analyze-smoke`` lane (``python -m mpi4torch_tpu.analyze
+--defects``) and tests/test_analyze.py run this one corpus.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .lints import LINT_NAMES, check_vjp_symmetry, run_lints
+from .parse import parse_program
+
+__all__ = [
+    "DEFECTS",
+    "Defect",
+    "DefectPrograms",
+    "run_defect_corpus",
+    "defect_ledger_problems",
+]
+
+
+@dataclass(frozen=True)
+class DefectPrograms:
+    """The clean programs the corpus mutates: a windowed split-phase
+    program (bucket ``.start``/``.wait`` spans), a permute-schedule
+    program (``source_target_pairs`` tables), a grouped-collective
+    program (``replica_groups``), and a forward / forward+backward
+    lowering pair of one registered algorithm."""
+    split_phase: str       # debug_info text with bucket start/wait spans
+    permute: str           # text carrying >= 1 collective_permute
+    grouped: str           # text carrying >= 1 replica_groups op
+    fwd: str               # forward lowering of a registered algorithm
+    fwdbwd: str            # value_and_grad lowering of the same program
+
+
+@dataclass(frozen=True)
+class Defect:
+    """One seeded defect: which clean program it mutates, the mutation,
+    and the lint that must catch it by name."""
+    name: str
+    lint: str                                  # LINT_NAMES entry
+    program: str                               # DefectPrograms field
+    doc: str
+    mutate: Callable[[str], str]
+
+
+def _first_bucket(text: str, phase: str) -> Optional[str]:
+    """The ``<Op>.bucket<i>of<n>`` label of the first bucket span with
+    ``phase`` in ``text``, or None."""
+    m = re.search(
+        r"mpi4torch\.([A-Za-z_]+\.bucket\d+of\d+)\." + phase, text)
+    return m.group(1) if m is not None else None
+
+
+def _mutate_drop_wait(text: str) -> str:
+    """Erase one bucket's ``.wait`` phase suffix — its start span now
+    dangles with no completion anywhere in the program."""
+    label = _first_bucket(text, "wait")
+    if label is None:
+        raise ValueError("no split-phase wait span to drop")
+    return text.replace(f"{label}.wait", label)
+
+
+def _mutate_orphan_wait(text: str) -> str:
+    """Erase one bucket's ``.start`` phase suffix — its wait span now
+    completes a handle nothing issued."""
+    label = _first_bucket(text, "start")
+    if label is None:
+        raise ValueError("no split-phase start span to orphan")
+    return text.replace(f"{label}.start", label)
+
+
+def _mutate_double_wait(text: str) -> str:
+    """Duplicate the wire collective of one bucket's wait phase — the
+    completion runs twice."""
+    parsed = parse_program(text)
+    for op in parsed.collectives:
+        b = op.bucket
+        if b is not None and b[3] == "wait":
+            lines = parsed.lines
+            lines = lines[:op.line + 1] + [lines[op.line]] \
+                + lines[op.line + 1:]
+            return "\n".join(lines)
+    raise ValueError("no wait-phase wire collective to duplicate")
+
+
+def _mutate_duplicate_permute_target(text: str) -> str:
+    """Point two sources at one target rank in the first permute's
+    ``source_target_pairs`` table."""
+    m = re.search(
+        r"source_target_pairs = dense<\[\[(-?\d+), (-?\d+)\], "
+        r"\[(-?\d+), (-?\d+)\]", text)
+    if m is None:
+        raise ValueError("no >= 2-pair source_target_pairs to mutate")
+    old = m.group(0)
+    new = (f"source_target_pairs = dense<[[{m.group(1)}, {m.group(2)}], "
+           f"[{m.group(3)}, {m.group(2)}]")
+    return text.replace(old, new, 1)
+
+
+def _mutate_non_partitioning_group(text: str) -> str:
+    """Make the first replica-group table list one rank twice and drop
+    another: the duplicated rank reduces twice, the dropped rank's
+    contribution never merges."""
+    m = re.search(r"replica_groups = dense<\[\[(-?\d+), (-?\d+)",
+                  text)
+    if m is None:
+        raise ValueError("no >= 2-wide replica_groups to mutate")
+    old = m.group(0)
+    new = f"replica_groups = dense<[[{m.group(1)}, {m.group(1)}"
+    return text.replace(old, new, 1)
+
+
+DEFECTS: Dict[str, Defect] = {}
+
+
+def _register(defect: Defect) -> Defect:
+    DEFECTS[defect.name] = defect
+    return defect
+
+
+_register(Defect(
+    name="dropped-wait", lint="split-phase", program="split_phase",
+    doc="a split-phase bucket's wait span erased (un-waited handle)",
+    mutate=_mutate_drop_wait))
+_register(Defect(
+    name="orphan-wait", lint="split-phase", program="split_phase",
+    doc="a split-phase bucket's start span erased (wait without start)",
+    mutate=_mutate_orphan_wait))
+_register(Defect(
+    name="double-wait", lint="split-phase", program="split_phase",
+    doc="a bucket's completion collective duplicated (double Wait)",
+    mutate=_mutate_double_wait))
+_register(Defect(
+    name="duplicated-permute-target", lint="permute-pairs",
+    program="permute",
+    doc="two sources shipping into one target rank",
+    mutate=_mutate_duplicate_permute_target))
+_register(Defect(
+    name="non-partitioning-group", lint="replica-groups",
+    program="grouped",
+    doc="a replica group listing one rank twice, another not at all",
+    mutate=_mutate_non_partitioning_group))
+_register(Defect(
+    name="dropped-backward", lint="vjp-symmetry", program="fwdbwd",
+    doc="a value_and_grad lowering with the backward collectives gone",
+    mutate=lambda text: text))  # special-cased: fwd stands in for fwdbwd
+
+
+def run_defect_corpus(programs: DefectPrograms) -> List[dict]:
+    """Apply every seeded defect and record whether its named lint
+    fired.  Each record: ``{"defect", "lint", "clean_ok", "fired",
+    "violations"}`` — a corpus cell passes only when the clean program
+    lints clean AND the mutant is caught by the expected lint name."""
+    records: List[dict] = []
+    for name in sorted(DEFECTS):
+        d = DEFECTS[name]
+        clean = getattr(programs, d.program)
+        if d.lint == "vjp-symmetry":
+            # The mutant pair: forward census present, backward absent —
+            # fwd standing in for the value_and_grad lowering.
+            clean_v = check_vjp_symmetry(programs.fwd, programs.fwdbwd)
+            viols = check_vjp_symmetry(programs.fwd, d.mutate(
+                programs.fwd), context=name)
+        else:
+            clean_v = [v for v in run_lints(clean) if v.lint == d.lint]
+            viols = run_lints(d.mutate(clean))
+        fired = any(v.lint == d.lint for v in viols)
+        records.append({
+            "defect": name,
+            "lint": d.lint,
+            "doc": d.doc,
+            "clean_ok": not clean_v,
+            "fired": fired,
+            "violations": [str(v) for v in viols],
+        })
+    return records
+
+
+def defect_ledger_problems(records=None) -> List[str]:
+    """The fired-defect ledger: every registered lint must be the named
+    catcher of at least one corpus defect (a lint without a defect
+    proving it fires is effectively untested), and — when ``records``
+    from :func:`run_defect_corpus` are given — every defect must have
+    fired on a clean baseline."""
+    problems: List[str] = []
+    covered = {d.lint for d in DEFECTS.values()}
+    missing = sorted(set(LINT_NAMES) - covered)
+    if missing:
+        problems.append(
+            f"lint(s) {missing} have no seeded defect in the corpus — "
+            "a lint without a mutant proving it fires is effectively "
+            "untested")
+    unknown = sorted(covered - set(LINT_NAMES))
+    if unknown:
+        problems.append(
+            f"defect(s) name unregistered lint(s) {unknown} — extend "
+            "analyze.LINT_NAMES")
+    for rec in records or []:
+        if not rec["clean_ok"]:
+            problems.append(
+                f"{rec['defect']}: the CLEAN program already violates "
+                f"{rec['lint']} — the corpus baseline is broken")
+        if not rec["fired"]:
+            problems.append(
+                f"{rec['defect']}: lint {rec['lint']} did not fire on "
+                "the mutated schedule")
+    return problems

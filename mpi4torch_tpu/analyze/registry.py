@@ -1,0 +1,204 @@
+"""One home for the registry-sync guards.
+
+Since PR 4 every subsystem that grew a registry also grew a guard
+asserting registry == coverage — algorithms vs census matrices
+(tests/test_tune.py), split-phase forms vs facade methods
+(tests/test_overlap.py), fault kinds vs the fault matrix
+(resilience), reshard step kinds vs both executors and the sweep
+(reshard), serving policies vs the parity matrix (serve) — each as its
+own copy of the same set-comparison shape.  This module dedupes them:
+:func:`set_drift` is the shared core (compare two name sets, return
+the caller's exact message on drift — the historical failure messages
+are preserved verbatim), and one ``*_problems`` function per domain
+rebuilds each guard on it.  The smoke lanes and the test files call
+these; ``python -m mpi4torch_tpu.analyze --sweep`` additionally runs
+every argument-free domain guard, so registry drift anywhere fails the
+analyze lane too.
+
+The coverage literals that pin what the *test matrices* cover (ALGOS,
+CENSUS_COVERED, SPLIT_CENSUS_COVERED, PARITY_POLICIES, ...) stay in
+the test/smoke files that own those matrices — a guard's job is to
+force the literal and the registry to move together, which only works
+if the literal lives next to the matrix it describes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+__all__ = [
+    "set_drift",
+    "resilience_problems",
+    "reshard_step_problems",
+    "serve_policy_problems",
+    "tune_problems",
+    "overlap_split_phase_problems",
+    "standing_problems",
+]
+
+
+def set_drift(registered: Iterable, covered: Iterable,
+              message: str) -> List[str]:
+    """The shared core of every registry-sync guard: ``[message]`` when
+    the two name sets differ, else ``[]``.  ``message`` may reference
+    ``{registered}`` and ``{covered}`` (each formatted as the sorted
+    list) so callers keep their historical failure texts."""
+    r, c = set(registered), set(covered)
+    if r == c:
+        return []
+    return [message.format(registered=sorted(r), covered=sorted(c))]
+
+
+# ------------------------------------------------------------- resilience
+
+def resilience_problems() -> List[str]:
+    """Fault-kind registry vs the censused matrix coverage (the body of
+    the historical ``resilience.__main__._check_registry_sync``, moved
+    here; messages unchanged)."""
+    from ..resilience.faults import FAULT_KINDS
+    from ..resilience.matrix import COMM_SUBSYSTEMS, COVERAGE
+
+    problems = set_drift(
+        FAULT_KINDS, COVERAGE,
+        "registry/coverage drift: registered={registered} "
+        "covered={covered} — every fault kind needs a "
+        "matrix row and vice versa")
+    for kind, rows in COVERAGE.items():
+        if kind not in FAULT_KINDS:
+            continue
+        sites = FAULT_KINDS[kind].sites
+        if "checkpoint" in sites:
+            if "checkpoint" not in rows:
+                problems.append(f"{kind}: checkpoint-site kind without a "
+                                "checkpoint cell")
+        else:
+            missing = set(COMM_SUBSYSTEMS) - set(rows)
+            if missing:
+                problems.append(f"{kind}: no cell for subsystem(s) "
+                                f"{sorted(missing)}")
+        if rows and all(v == "inert" for v in rows.values()):
+            problems.append(f"{kind}: inert in EVERY subsystem — the "
+                            "kind is effectively untested")
+    return problems
+
+
+# ---------------------------------------------------------------- reshard
+
+def reshard_step_problems(exercised: Optional[Set[str]] = None
+                          ) -> List[str]:
+    """Step-kind registry vs both executor dispatch tables, plus —
+    when the sweep passes the step kinds its forward+adjoint plans
+    actually exercised — sweep coverage (messages from the historical
+    reshard-smoke guard)."""
+    from ..reshard import STEP_KINDS
+    from ..reshard.executor import _EAGER_EXEC, _SPMD_EXEC
+
+    kinds = set(STEP_KINDS)
+    probs: List[str] = []
+    if set(_SPMD_EXEC) != kinds:
+        probs.append(f"SPMD executor serves {sorted(_SPMD_EXEC)}")
+    if set(_EAGER_EXEC) != kinds:
+        probs.append(f"eager executor serves {sorted(_EAGER_EXEC)}")
+    if exercised is not None and set(exercised) != kinds:
+        probs.append(
+            f"sweep exercised {sorted(exercised)} of {sorted(kinds)}")
+    return probs
+
+
+# ------------------------------------------------------------------ serve
+
+def serve_policy_problems(parity_policies: Iterable) -> List[str]:
+    """Scheduling-policy registry vs the parity-covered set the
+    engine-vs-oracle matrix enumerates (message from the historical
+    serve-smoke guard)."""
+    from ..serve import POLICIES
+
+    return set_drift(
+        POLICIES, parity_policies,
+        "policy registry {registered} != parity-covered set {covered} "
+        "— every scheduling policy needs oracle-parity coverage")
+
+
+# ------------------------------------------------------------------- tune
+
+def tune_problems(algos: Iterable, census_covered: Iterable,
+                  codec_capable: Iterable) -> List[str]:
+    """Algorithm registry vs the parity/census matrices and the
+    codec-capability cross-declarations (messages from the historical
+    tests/test_tune.py guard)."""
+    from .. import tune
+    from ..compress import available_codecs, get_codec
+
+    registered = set(tune.available_algorithms())
+    problems = set_drift(
+        registered, algos,
+        "registered algorithms {registered} out of sync with "
+        "the parity/grads test matrix {covered} — extend "
+        "ALGOS (and the tests it parametrizes)")
+    problems += set_drift(
+        registered, census_covered,
+        "registered algorithms {registered} out of sync with "
+        "the HLO census matrix {covered} — add a "
+        "forward+backward census test and list the name in "
+        "CENSUS_COVERED")
+    capable = {a for a in registered
+               if tune.get_algorithm(a).codec_capable}
+    problems += set_drift(
+        capable, codec_capable,
+        "codec-capable algorithms {registered} out of sync with "
+        "CODEC_CAPABLE {covered} — extend the literal "
+        "(and check TestCodecAlgorithmCensus covers the new schedule)")
+    for name in available_codecs():
+        declared = set(get_codec(name).algorithms)
+        if not declared <= capable:
+            problems.append(
+                f"codec {name!r} declares algorithms {sorted(declared)} "
+                "outside the registry's codec_capable set — either mark "
+                "the algorithm codec_capable (and census the pair) or "
+                "fix the codec's declaration")
+        if not declared:
+            problems.append(
+                f"codec {name!r} declares no algorithms — "
+                "even exact-wire fallbacks need 'ring'")
+    return problems
+
+
+# ---------------------------------------------------------------- overlap
+
+def overlap_split_phase_problems(census_covered: Iterable) -> List[str]:
+    """Split-phase form registry vs the facade's ``*_start`` surface
+    and the census matrix (messages from the historical
+    tests/test_overlap.py guard)."""
+    from ..comm import MPI_Communicator
+    from ..overlap import SPLIT_PHASE_FORMS
+
+    registered = set(SPLIT_PHASE_FORMS)
+    facade_starts = {m[:-len("_start")] for m in dir(MPI_Communicator)
+                     if m.endswith("_start") and not m.startswith("_")}
+    problems = set_drift(
+        facade_starts, registered,
+        "facade *_start methods {registered} out of sync "
+        "with overlap.SPLIT_PHASE_FORMS {covered}")
+    problems += set_drift(
+        registered, census_covered,
+        "registered split-phase forms {registered} out of sync "
+        "with the census matrix {covered} — add a "
+        "start-precedes-compute census test and list the form")
+    return problems
+
+
+# ------------------------------------------------------------- everything
+
+def standing_problems() -> List[str]:
+    """Every registry-sync guard that needs no caller-side coverage
+    literal (the test-matrix literals live with their matrices): the
+    resilience fault matrix, the reshard executor tables, and the
+    serve parity set published by its smoke lane.  The analyze sweep
+    runs this, so a drift in ANY subsystem registry fails the
+    ``make analyze-smoke`` lane too."""
+    problems = [f"resilience: {p}" for p in resilience_problems()]
+    problems += [f"reshard: {p}" for p in reshard_step_problems()]
+    from ..serve.__main__ import PARITY_POLICIES
+    problems += [f"serve: {p}"
+                 for p in serve_policy_problems(PARITY_POLICIES)]
+    return problems

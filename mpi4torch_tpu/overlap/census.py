@@ -33,49 +33,22 @@ ordering censuses assert op-by-op, folded down to one fraction).
 exposed-comm fraction — blocking programs census to 1.0, windowed
 split-phase programs strictly lower — next to the wall-clock fractions
 that become meaningful on real multi-chip hardware.
+
+Since the static verifier landed (:mod:`mpi4torch_tpu.analyze`), the
+parsing and the window classification live there as a pass over the
+shared StableHLO parse — this module keeps the historical entry point
+(and its recorded fractions, regression-pinned bit-identical in
+tests/test_analyze.py) as a delegation.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Dict, List, Tuple
+from typing import Dict
+
+from ..analyze.accounting import scheduled_exposure as _scheduled_exposure
+from ..analyze.parse import WIRE_OPS
 
 __all__ = ["scheduled_exposure", "WIRE_OPS"]
-
-# StableHLO op kinds that put bytes on the wire (or rendezvous ranks):
-# a bucket window containing one of these from another collective has
-# real in-flight company.
-WIRE_OPS = frozenset({
-    "reduce_scatter", "all_gather", "all_reduce", "collective_permute",
-    "all_to_all",
-})
-
-_LOC_DEF = re.compile(r'^#loc(\d+) = loc\("([^"]*)"')
-_LOC_REF = re.compile(r"loc\(#loc(\d+)\)")
-_LOC_INLINE = re.compile(r'loc\("([^"]*)"')
-_OP_KIND = re.compile(r'"?stablehlo\.([a-z_0-9]+)"?')
-_BUCKET = re.compile(
-    r"mpi4torch\.(?P<op>[A-Za-z_]+)\.bucket(?P<i>\d+)of(?P<n>\d+)"
-    r"(?P<rest>(?:\.\w+)*)")
-
-
-def _as_debug_text(lowered_or_text) -> str:
-    if isinstance(lowered_or_text, str):
-        return lowered_or_text
-    from .._compat import lowered_text
-    return lowered_text(lowered_or_text, debug_info=True)
-
-
-def _bucket_of(scope: str):
-    """(op, bucket, total, phase) of the outermost bucket_scope span in a
-    location path, or None."""
-    m = _BUCKET.search(scope)
-    if m is None:
-        return None
-    rest = m.group("rest").split(".")
-    phase = ("start" if "start" in rest
-             else "wait" if "wait" in rest else None)
-    return (m.group("op"), int(m.group("i")), int(m.group("n")), phase)
 
 
 def scheduled_exposure(lowered_or_text) -> Dict:
@@ -87,67 +60,4 @@ def scheduled_exposure(lowered_or_text) -> Dict:
     ``{"split_phase": bool, "exposed": bool}``.  ``exposed_fraction`` is
     ``None`` when the program contains no bucket collectives (e.g. a
     single-device world whose collectives lowered away)."""
-    text = _as_debug_text(lowered_or_text)
-    lines = text.splitlines()
-
-    loc_names: Dict[str, str] = {}
-    for ln in lines:
-        m = _LOC_DEF.match(ln)
-        if m is not None:
-            loc_names[m.group(1)] = m.group(2)
-
-    # Ordered op events: (line index, stablehlo kind, bucket key, phase).
-    events: List[Tuple[int, str, object, object]] = []
-    for idx, ln in enumerate(lines):
-        if ln.startswith("#loc"):
-            continue
-        km = _OP_KIND.search(ln)
-        if km is None:
-            continue
-        ref = _LOC_REF.search(ln)
-        scope = (loc_names.get(ref.group(1), "") if ref is not None
-                 else "")
-        if not scope:
-            im = _LOC_INLINE.search(ln)
-            scope = im.group(1) if im is not None else ""
-        b = _bucket_of(scope)
-        key, phase = (None, None) if b is None else (b[:3], b[3])
-        events.append((idx, km.group(1), key, phase))
-
-    by_bucket: Dict[tuple, Dict[str, List[int]]] = {}
-    for idx, kind, key, phase in events:
-        if key is None:
-            continue
-        slot = by_bucket.setdefault(key, {"start": [], "wait": [],
-                                          "plain": []})
-        slot[phase or "plain"].append(idx)
-
-    wire = [(idx, key) for idx, kind, key, _ in events
-            if kind in WIRE_OPS]
-
-    buckets = {}
-    n_exposed = 0
-    for key in sorted(by_bucket):
-        slot = by_bucket[key]
-        split = bool(slot["start"] and slot["wait"])
-        if split:
-            lo, hi = max(slot["start"]), min(slot["wait"])
-            hidden = any(lo < idx < hi and wkey != key
-                         for idx, wkey in wire)
-            exposed = not hidden
-        else:
-            # Blocking bucket (or a start that was never waited —
-            # defensively exposed): zero-width completion window.
-            exposed = True
-        n_exposed += exposed
-        op, i, n = key
-        buckets[f"{op}.bucket{i}of{n}"] = {"split_phase": split,
-                                           "exposed": exposed}
-
-    nb = len(buckets)
-    return {
-        "n_buckets": nb,
-        "n_exposed": n_exposed,
-        "exposed_fraction": (round(n_exposed / nb, 4) if nb else None),
-        "buckets": buckets,
-    }
+    return _scheduled_exposure(lowered_or_text)

@@ -63,7 +63,6 @@ def _smoke() -> int:
     import mpi4torch_tpu as mpi
     from mpi4torch_tpu import reshard as rs
     from mpi4torch_tpu._compat import shard_map
-    from mpi4torch_tpu.reshard.executor import _EAGER_EXEC, _SPMD_EXEC
     from jax.sharding import Mesh, PartitionSpec as P
 
     n = len(jax.devices())
@@ -182,16 +181,12 @@ def _smoke() -> int:
             print("cell migrate/vjp: cotangents redistribute "
                   "spec'->spec bitwise")
 
-    # Registry-sync guard.
+    # Registry-sync guard (the shared checker in
+    # mpi4torch_tpu.analyze.registry; messages unchanged).
+    from mpi4torch_tpu.analyze.registry import reshard_step_problems
+
     kinds = set(rs.STEP_KINDS)
-    probs = []
-    if set(_SPMD_EXEC) != kinds:
-        probs.append(f"SPMD executor serves {sorted(_SPMD_EXEC)}")
-    if set(_EAGER_EXEC) != kinds:
-        probs.append(f"eager executor serves {sorted(_EAGER_EXEC)}")
-    if exercised != kinds:
-        probs.append(
-            f"sweep exercised {sorted(exercised)} of {sorted(kinds)}")
+    probs = reshard_step_problems(exercised)
     if probs:
         failures += 1
         print("FAIL registry-sync: " + "; ".join(probs))

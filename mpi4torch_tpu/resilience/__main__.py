@@ -22,34 +22,13 @@ import sys
 
 
 def _check_registry_sync() -> list:
-    from .faults import FAULT_KINDS
-    from .matrix import COMM_SUBSYSTEMS, COVERAGE
+    # The checker body moved to the shared registry-guard home
+    # (mpi4torch_tpu.analyze.registry) with its messages intact; this
+    # name stays as THE entry point the smoke lane and
+    # tests/test_resilience.py share.
+    from ..analyze.registry import resilience_problems
 
-    problems = []
-    registered = set(FAULT_KINDS)
-    covered = set(COVERAGE)
-    if registered != covered:
-        problems.append(
-            f"registry/coverage drift: registered={sorted(registered)} "
-            f"covered={sorted(covered)} — every fault kind needs a "
-            "matrix row and vice versa")
-    for kind, rows in COVERAGE.items():
-        if kind not in FAULT_KINDS:
-            continue
-        sites = FAULT_KINDS[kind].sites
-        if "checkpoint" in sites:
-            if "checkpoint" not in rows:
-                problems.append(f"{kind}: checkpoint-site kind without a "
-                                "checkpoint cell")
-        else:
-            missing = set(COMM_SUBSYSTEMS) - set(rows)
-            if missing:
-                problems.append(f"{kind}: no cell for subsystem(s) "
-                                f"{sorted(missing)}")
-        if rows and all(v == "inert" for v in rows.values()):
-            problems.append(f"{kind}: inert in EVERY subsystem — the "
-                            "kind is effectively untested")
-    return problems
+    return resilience_problems()
 
 
 def _smoke() -> int:

@@ -82,6 +82,17 @@ class AlgorithmSpec:
     codec_capable: bool = False
     requires_power_of_two: bool = False
     requires_factorable: bool = False
+    # The algorithm's VJP-symmetry declaration, checked structurally by
+    # the static verifier (mpi4torch_tpu.analyze, `make analyze-smoke`):
+    # "self" declares that the backward pass re-runs the same schedule
+    # (allreduce is self-adjoint — psum's VJP is psum — so every
+    # shipped allreduce schedule's backward census equals its forward
+    # census), a dict declares a kind->kind transpose mapping (e.g.
+    # {"all_gather": "reduce_scatter"} for a gather-shaped schedule
+    # whose adjoint scatters).  A newly registered algorithm must
+    # declare its symmetry here; the analyze sweep lints the claim
+    # against the actual value_and_grad lowering.
+    vjp_census: object = "self"
     description: str = ""
 
     def applicable(self, nranks: int,

@@ -7,6 +7,13 @@ docstrings: one op in the source program produces exactly the stated XLA
 collectives, matched p2p pairs fuse into ONE collective_permute, adjoints
 add exactly their stated collective, and the Bcast_ size dispatch picks
 the documented strategy per payload class.
+
+The matchers ride the shared StableHLO parse (mpi4torch_tpu.analyze):
+``census()`` is :meth:`~mpi4torch_tpu.analyze.ParsedProgram.census`,
+and the compressed-path assertions read payload dtypes and named-scope
+labels off the typed :class:`~mpi4torch_tpu.analyze.CollectiveOp`
+records instead of ad-hoc regexes over the text.  Assertion counts and
+expected values are unchanged from the regex era.
 """
 
 import math
@@ -19,12 +26,12 @@ from mpi4torch_tpu._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import mpi4torch_tpu as mpi
+from mpi4torch_tpu import analyze
 from mpi4torch_tpu.ops import spmd as spmd_mod
 
 NR = 4
 
-COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
-               "collective_permute")
+COLLECTIVES = analyze.COLLECTIVE_KINDS
 
 
 def census(fn, *args):
@@ -40,7 +47,7 @@ def census(fn, *args):
     wrapped = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
                        check_vma=False)
     txt = jax.jit(wrapped).lower(*args).as_text()
-    return {c: txt.count(f"stablehlo.{c}") for c in COLLECTIVES}
+    return analyze.parse_program(txt).census()
 
 
 def only(**expected):
@@ -355,15 +362,15 @@ class TestCompressedCensus:
         txt = self._lowered(
             lambda c, x: c.Allreduce(x, mpi.MPI_SUM, compression="q8"),
             jnp.ones((512,), jnp.float32))
-        import re
+        parsed = analyze.parse_program(txt)
         # ring hops: collective_permute on int8 tensors
-        assert re.search(r"collective_permute.*xi8>", txt), \
+        assert parsed.ops("collective_permute", dtype="i8"), \
             "no int8-width collective_permute in the compressed lowering"
         # final stage: the encoded shards all_gather as int8
-        assert re.search(r"all_gather.*xi8>", txt), \
+        assert parsed.ops("all_gather", dtype="i8"), \
             "no int8-width all_gather in the compressed lowering"
         # nothing rides the wire at full fp32 width
-        assert txt.count("stablehlo.all_reduce") == 0
+        assert parsed.census()["all_reduce"] == 0
 
     def test_q8_allreduce_wire_census(self):
         got = census(lambda c, x: c.Allreduce(x, mpi.MPI_SUM,
@@ -388,22 +395,28 @@ class TestCompressedCensus:
         assert got["all_gather"] == 2 * 2
 
     def test_q8_allgather_ships_int8(self):
-        import re
-
         txt = self._lowered(
             lambda c, x: c.Allgather(x, 0, compression="q8"),
             jnp.ones((64,), jnp.float32))
-        assert re.search(r"all_gather.*xi8>", txt)
+        assert analyze.parse_program(txt).ops("all_gather", dtype="i8")
 
     def test_named_scope_carries_codec_suffix(self):
+        # The codec suffix must sit on the WIRE ops' own scope paths —
+        # the analyzer recovers each collective's label from the
+        # debug-info loc table, so the assertion is per-op, not a
+        # whole-text substring.
         txt = self._lowered(
             lambda c, x: c.Allreduce(x, mpi.MPI_SUM, compression="q8"),
             jnp.ones((64,), jnp.float32))
-        assert "mpi4torch.Allreduce.q8" in txt
+        parsed = analyze.parse_program(txt)
+        assert any(op.label == "mpi4torch.Allreduce.q8"
+                   for op in parsed.collectives)
         txt_bwd = self._lowered(
             lambda c, x: c.Allreduce(x, mpi.MPI_SUM, compression="q8"),
             jnp.ones((64,), jnp.float32), grad=True)
-        assert "mpi4torch.AllreduceBackward.q8" in txt_bwd
+        parsed_bwd = analyze.parse_program(txt_bwd)
+        assert any("mpi4torch.AllreduceBackward.q8" in op.scope
+                   for op in parsed_bwd.collectives)
 
     def test_exact_path_untouched(self):
         # compression=None keeps the documented exact lowering.

@@ -362,8 +362,12 @@ class TestPolicies:
         """Every registered policy is covered by the parity matrix
         (which parametrizes over serve.POLICIES); pinning the known set
         makes registering a policy without extending coverage a loud CI
-        failure rather than a silent gap."""
-        assert set(serve.POLICIES) == {"fcfs", "shortest_first"}
+        failure rather than a silent gap.  One checker
+        (analyze.registry.serve_policy_problems) shared with the
+        serve-smoke lane."""
+        from mpi4torch_tpu.analyze.registry import serve_policy_problems
+
+        assert serve_policy_problems(("fcfs", "shortest_first")) == []
 
     def test_shortest_first_orders_admissions(self):
         params = _params(CFG)
