@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -122,6 +122,23 @@ analyze-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.analyze --sweep --defects
+
+# CPU smoke run of the runtime observability layer (mpi4torch_tpu.obs):
+# the static-vs-runtime reconciliation — four traced Mode B schedules
+# (plain ring allreduce, fused q8 buckets, the (8,)->(2,4) reshard
+# migration, an overlap serve decode step) whose measured wire bytes
+# AND per-kind collective counts must match the analyze predictions of
+# their Mode A lowerings EXACTLY — plus the flight-recorder postmortem
+# on an injected rank_death (dead rank named, survivor tails
+# consistent), the off-path census (obs-disabled lowering bit-identical
+# to an obs-less build; a mode_a tracer prices exactly one host
+# callback per collective entry), and the unified-metrics surfaces
+# (retry events, integrity violations, serve counters, Prometheus
+# exposition).  Exits non-zero on any divergence.
+obs-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.obs --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

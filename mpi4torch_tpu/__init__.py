@@ -71,6 +71,7 @@ from . import resilience
 from . import reshard
 from . import serve
 from . import analyze
+from . import obs
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
@@ -121,6 +122,7 @@ __all__ = [
     "reshard",
     "serve",
     "analyze",
+    "obs",
     "SpmdWaitHandle",
     "FaultPlan",
     "FaultSpec",

@@ -41,7 +41,7 @@ copies.  ``make analyze-smoke`` runs sweep + defect corpus on the
 """
 
 from .accounting import (peak_live_bytes, scheduled_exposure,
-                         wire_bytes_per_device)
+                         wire_bytes_per_device, wire_contribution)
 from .defects import (DEFECTS, Defect, DefectPrograms,
                       defect_ledger_problems, run_defect_corpus)
 from .lints import (LINT_NAMES, LintViolation, check_vjp_symmetry,
@@ -65,6 +65,7 @@ __all__ = [
     "run_lints",
     "check_vjp_symmetry",
     "wire_bytes_per_device",
+    "wire_contribution",
     "peak_live_bytes",
     "scheduled_exposure",
     "DEFECTS",

@@ -42,6 +42,7 @@ from .parse import (WIRE_OPS, ParsedProgram, dtype_bytes,
                     parse_program, tensor_bytes)
 
 __all__ = [
+    "wire_contribution",
     "wire_bytes_per_device",
     "peak_live_bytes",
     "scheduled_exposure",
@@ -68,6 +69,30 @@ def _payload_bytes(op) -> int:
     return n
 
 
+def wire_contribution(kind: str, payload_bytes: float,
+                      group_size: int = None) -> float:
+    """Per-device bytes-on-wire of ONE collective under the standard
+    ring accountings (module docstring): THE shared formula — the
+    static pass below applies it to parsed StableHLO ops, and the
+    runtime reconciler (:func:`mpi4torch_tpu.obs.reconcile`) applies it
+    to censused Mode B chokepoint payloads, so the two sides can only
+    agree or disagree about the *traffic*, never about the pricing
+    rule."""
+    if kind == "collective_permute":
+        return float(payload_bytes)
+    s = group_size
+    if s is None or s < 1:
+        raise ValueError(
+            f"{kind} needs a replica-group size to price; got {s!r}")
+    if kind == "all_gather":
+        return (s - 1) * float(payload_bytes)
+    if kind == "all_reduce":
+        return 2 * (s - 1) / s * float(payload_bytes)
+    if kind in ("reduce_scatter", "all_to_all"):
+        return (s - 1) / s * float(payload_bytes)
+    raise ValueError(f"unknown wire collective kind {kind!r}")
+
+
 def wire_bytes_per_device(lowered_or_text) -> Tuple[int, Dict[str, int]]:
     """Deterministic per-device bytes-on-wire of a lowered program
     (see module docstring for the per-kind accountings).  Returns
@@ -78,20 +103,11 @@ def wire_bytes_per_device(lowered_or_text) -> Tuple[int, Dict[str, int]]:
     wire = 0.0
     counts: Dict[str, int] = {}
     for op in parsed.collectives:
-        if op.kind == "collective_permute":
-            contrib = _payload_bytes(op)
-        else:
-            s = op.group_size
-            if s is None:
-                continue  # no replica_groups: not a priceable transfer
-            if op.kind == "all_gather":
-                contrib = (s - 1) * _payload_bytes(op)
-            elif op.kind == "all_reduce":
-                contrib = 2 * (s - 1) / s * _payload_bytes(op)
-            else:  # reduce_scatter / all_to_all: (s-1)/s of the payload
-                contrib = (s - 1) / s * _payload_bytes(op)
+        if op.kind != "collective_permute" and op.group_size is None:
+            continue  # no replica_groups: not a priceable transfer
         counts[op.kind] = counts.get(op.kind, 0) + 1
-        wire += contrib
+        wire += wire_contribution(op.kind, _payload_bytes(op),
+                                  op.group_size)
     return int(round(wire)), counts
 
 

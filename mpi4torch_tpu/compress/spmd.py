@@ -451,6 +451,11 @@ def allreduce(ctx, x, op: int, codec: Codec, algorithm=None,
     # pipeline would otherwise saturate block scales silently.
     from ..resilience import guards as _guards
     x = _guards.spmd_finite_value(x, f"Allreduce[{codec.name}]")
+    # Mode A step-event hook (mpi4torch_tpu.obs) — the compressed
+    # pipeline's entry reports with its codec label; zero ops when no
+    # mode_a tracer is installed (see ops/spmd.py allreduce).
+    from ..obs.trace import spmd_collective_event
+    x = spmd_collective_event(x, f"Allreduce[{codec.name}]")
     algo = resolve_algorithm(ctx.size, x, codec, algorithm,
                              algorithm_explicit)
 

@@ -681,6 +681,36 @@ def set_fault_plan(plan) -> None:
     _fault_plan = as_plan(plan)
 
 
+# ---------------------------------------------------------------------------
+# Runtime observability (mpi4torch_tpu.obs; ISSUE 12)
+# ---------------------------------------------------------------------------
+
+# The active comm tracer (mpi4torch_tpu.obs.CommTracer), or None
+# (default: the zero-overhead fast path — one attribute read per
+# chokepoint, the fault-plan discipline).  PROCESS-wide like the fault
+# plan: events must flow from run_ranks rank-threads, which a
+# thread-local scope opened outside them would miss; obs.trace() is the
+# save/restore wrapper.
+_comm_tracer = None
+
+
+def comm_tracer():
+    """The active comm tracer (or None).  See
+    :mod:`mpi4torch_tpu.obs`."""
+    return _comm_tracer
+
+
+def set_comm_tracer(tracer) -> None:
+    """Install a process-wide comm tracer (an
+    :class:`~mpi4torch_tpu.obs.CommTracer`, or None to disable).  With
+    ``tracer.mode_a`` set, Mode A lowerings gain the step-event host
+    callback — the flag rides :func:`thresholds_fingerprint`, so
+    installing/removing such a tracer retraces instead of reusing the
+    uninstrumented lowering."""
+    global _comm_tracer
+    _comm_tracer = tracer
+
+
 def thresholds_fingerprint():
     """Hashable snapshot of every trace-time threshold/selection knob —
     ``run_spmd`` folds it into its jit cache key so overriding a
@@ -691,12 +721,18 @@ def thresholds_fingerprint():
     # lowering (censused in bench.py _bench_guard_overhead and
     # tests/test_resilience.py) — keying it in would force a full
     # retrace/recompile for zero semantic effect.
+    # The obs tracer keys in only as "does Mode A get the step-event
+    # callback": a Mode B-only tracer (mode_a=False, the default) never
+    # moves the lowering, so it must not force a retrace either —
+    # censused in bench.py _bench_obs_overhead, like _comm_wire_checksum.
     return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
             _hier_group_size, _chain_unroll_max, _quant_hop_impl,
             _comm_finite_guard, _reshard_strategy,
-            _serve_decode_buckets)
+            _serve_decode_buckets,
+            bool(_comm_tracer is not None
+                 and getattr(_comm_tracer, "mode_a", False)))
 
 
 @contextmanager

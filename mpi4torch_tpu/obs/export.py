@@ -1,0 +1,74 @@
+"""Chrome-trace / Perfetto JSON export of the Mode B event timeline.
+
+The Mode A story already has a capture path — ``utils.profiler_trace``
+writes xplane protobufs the TensorBoard profile plugin / xprof /
+Perfetto read natively.  This module gives the Mode B chokepoint trace
+the same viewer: :func:`chrome_trace` renders a
+:class:`~.events.CommEvent` list as the Chrome Trace Event Format
+(the ``traceEvents`` JSON Perfetto and ``chrome://tracing`` both load),
+one timeline row per (world, rank), complete ("X") events with the
+op/bytes/retries/status in ``args`` so a hung collective shows as the
+row where every rank's lane goes quiet except the one that never
+arrived.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional
+
+__all__ = ["chrome_trace", "write_chrome_trace"]
+
+
+def chrome_trace(events: Iterable, label: str = "mpi4torch_tpu") -> dict:
+    """Chrome Trace Event Format dict of an event list.
+
+    Timestamps are microseconds relative to the earliest event (the
+    absolute ``perf_counter`` epoch is meaningless across processes);
+    ``pid`` is the world ordinal, ``tid`` the rank (Mode A step events
+    land on the ``spmd`` pseudo-row), so Perfetto renders one lane per
+    rank with the collective spans aligned."""
+    evs = sorted(events, key=lambda e: (e.t_start, e.seq))
+    t0 = evs[0].t_start if evs else 0.0
+    out = {"displayTimeUnit": "ms", "traceEvents": [],
+           "otherData": {"source": label}}
+    named = set()
+    for e in evs:
+        pid = e.world if e.world >= 0 else 9999
+        tid = e.rank if e.rank >= 0 else 0
+        if (pid, tid) not in named:
+            named.add((pid, tid))
+            out["traceEvents"].append({
+                "ph": "M", "pid": pid, "tid": tid,
+                "name": "thread_name",
+                "args": {"name": (f"rank{e.rank}" if e.rank >= 0
+                                  else "spmd (Mode A)")}})
+        name = e.op
+        if e.codec:
+            name += f".{e.codec}"
+        if e.algorithm and e.algorithm != "ring":
+            name += f".{e.algorithm}"
+        out["traceEvents"].append({
+            "ph": "X", "pid": pid, "tid": tid, "name": name,
+            "cat": e.channel,
+            "ts": (e.t_start - t0) * 1e6,
+            "dur": max(e.duration_s, 0.0) * 1e6,
+            "args": {
+                "seq": e.seq,
+                "payload_bytes": e.payload_bytes,
+                "retries": e.retries,
+                "status": e.status,
+                "bucket": e.bucket,
+                "signature": repr(e.signature),
+            }})
+    return out
+
+
+def write_chrome_trace(path: str, events: Iterable,
+                       label: str = "mpi4torch_tpu") -> str:
+    """Write :func:`chrome_trace` JSON to ``path`` (load it in Perfetto
+    via "Open trace file", or ``chrome://tracing``).  Returns the
+    path."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(chrome_trace(events, label=label), f)
+    return path

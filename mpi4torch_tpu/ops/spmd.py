@@ -1067,6 +1067,13 @@ def allreduce(ctx: SpmdContext, x, op: int, algorithm=None,
     # thresholds fingerprint, so toggling retraces.
     from ..resilience import guards as _guards
     x = _guards.spmd_finite_value(x, "Allreduce")
+    # Mode A step-event hook (mpi4torch_tpu.obs): same trace-time
+    # discipline as the finite guard — no tracer (or mode_a off) means
+    # zero ops added (censused in bench.py _bench_obs_overhead); a
+    # mode_a tracer adds one host callback per collective entry, and
+    # the flag rides the thresholds fingerprint so toggling retraces.
+    from ..obs.trace import spmd_collective_event
+    x = spmd_collective_event(x, "Allreduce")
     if algorithm is None:
         algorithm = _auto_allreduce_algorithm(ctx, x)
     if algorithm in ("hier", "torus") and ctx.size > 1:

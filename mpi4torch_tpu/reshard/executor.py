@@ -252,7 +252,15 @@ def _npput(buf, starts, val, accumulate):
 
 
 def _esig(st, i, v):
-    return (f"Reshard.{st.kind}", i, tuple(v.shape),
+    """Rendezvous signature of one eager plan step.  Carries the step's
+    replica-group size (plan state — identical on every rank) so the
+    obs tracer can price grouped steps with the standard accountings
+    (a grouped all_to_all ships (g-1)/g of the payload, not
+    (world-1)/world; mpi4torch_tpu.obs.reconcile); ``None`` means the
+    whole communicator participates."""
+    groups = getattr(st, "groups", None)
+    gs = len(groups[0]) if groups else None
+    return (f"Reshard.{st.kind}", i, gs, tuple(v.shape),
             str(jnp.asarray(v).dtype))
 
 

@@ -117,6 +117,13 @@ def _record(where: str, mode: str, ranks=()) -> None:
     with _viol_lock:
         _violations.append(
             {"where": where, "mode": mode, "ranks": sorted(ranks)})
+    # Observability surface (mpi4torch_tpu.obs): violations are rare by
+    # definition, so the metric write sits off the guard fast path; the
+    # ledger (last_violation) stays the deterministic poll surface.
+    from ..obs import metrics as _metrics
+    _metrics.inc("integrity_violations_total",
+                 help="finite-guard/checksum violations recorded by the "
+                      "resilience guards")
 
 
 def last_violation() -> Optional[dict]:

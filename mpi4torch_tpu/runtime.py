@@ -389,7 +389,28 @@ class World:
         """All ranks deposit (signature, payload); returns the list of all
         payloads in rank order.  Signature mismatch across ranks raises on
         every rank (MPI would deadlock/corrupt; see class docstring).
+
+        This is chokepoint #1 of the runtime observability layer
+        (mpi4torch_tpu.obs): with a tracer installed, every rendezvous
+        is recorded as a typed CommEvent (payload bytes censused,
+        retries attributed, failures snapshotted by the flight
+        recorder).  Off path: one attribute read — the fault-plan
+        discipline.
         """
+        tracer = _cfg.comm_tracer()
+        if tracer is None:
+            return self._exchange(rank, signature, payload, None)
+        meter = tracer.begin(self, rank, "exchange", signature, payload)
+        try:
+            out = self._exchange(rank, signature, payload, meter)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            tracer.commit(meter, error=e)
+            raise
+        tracer.commit(meter)
+        return out
+
+    def _exchange(self, rank: int, signature: Tuple, payload: Any,
+                  meter) -> List[Any]:
         self._check_failed()
         plan = _cfg.fault_plan()
         if plan is not None:
@@ -403,7 +424,7 @@ class World:
             payload = plan.on_exchange(self, rank, signature, payload)
         self._sigs[rank] = signature
         self._slots[rank] = payload
-        self._wait_barrier(rank)
+        self._wait_barrier(rank, meter)
         sig0 = self._sigs[0]
         if any(s != sig0 for s in self._sigs):
             err = CollectiveMismatchError(
@@ -414,13 +435,31 @@ class World:
             # to abort the barrier.
             raise err
         out = list(self._slots)
-        self._wait_barrier(rank)  # all readers done before slots are reused
+        # all readers done before slots are reused
+        self._wait_barrier(rank, meter)
         return out
 
     def barrier(self, rank: int) -> None:
         self.exchange(rank, ("Barrier",), None)
 
-    def _wait_barrier(self, rank: int):
+    def _count_retries(self, used: int, meter) -> None:
+        """Retry-extension bookkeeping shared by the rendezvous barrier
+        and the p2p receive loop: the world counter (the historical
+        bare-attribute surface, kept), the obs metric
+        (``mpi4torch_comm_retry_events_total``), and the per-operation
+        meter when a tracer is active.  Off the hot path by
+        construction — this only runs when a retry actually rescued a
+        wait."""
+        with self._err_lock:
+            self.retry_events += used
+        if meter is not None:
+            meter.add_retries(used)
+        from .obs import metrics as _metrics
+        _metrics.inc("comm_retry_events_total", used,
+                     help="retry extensions consumed by rendezvous/p2p "
+                          "waits that eventually completed")
+
+    def _wait_barrier(self, rank: int, meter=None):
         try:
             used = self._barrier.wait(rank, self.timeout,
                                       retries=_cfg.comm_retries(),
@@ -431,8 +470,7 @@ class World:
             self._raise_broken(b.arrived)
         else:
             if used:
-                with self._err_lock:
-                    self.retry_events += used
+                self._count_retries(used, meter)
 
     def _rank_failed_error(self, verb: str) -> RankFailedError:
         """The dead-rank attribution, shared by every raise site."""
@@ -521,7 +559,23 @@ class World:
 
     def p2p_send(self, src: int, dst: int, tag: int, payload: Any) -> None:
         """Buffered-mode send: never blocks (the eager analogue of MPI_Isend,
-        csrc/extension.cpp:1071-1113)."""
+        csrc/extension.cpp:1071-1113).  Chokepoint #2a of the obs
+        tracing layer (see :meth:`exchange`)."""
+        tracer = _cfg.comm_tracer()
+        if tracer is None:
+            return self._p2p_send(src, dst, tag, payload, None)
+        meter = tracer.begin(self, src, "p2p_send", ("p2p_send", tag),
+                             payload, peer=dst, tag=tag)
+        try:
+            out = self._p2p_send(src, dst, tag, payload, meter)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            tracer.commit(meter, error=e)
+            raise
+        tracer.commit(meter)
+        return out
+
+    def _p2p_send(self, src: int, dst: int, tag: int, payload: Any,
+                  meter) -> None:
         self._check_failed()
         if not (0 <= dst < self.size):
             raise CommError(f"invalid destination rank {dst} (size {self.size})")
@@ -543,7 +597,23 @@ class World:
         (``config.comm_backoff``), each retry first requesting
         redelivery of any fault-dropped message — the eager analogue of
         a NACK-triggered retransmission — so a transient message drop
-        recovers instead of deadlocking."""
+        recovers instead of deadlocking.  Chokepoint #2b of the obs
+        tracing layer (see :meth:`exchange`); the received payload's
+        bytes are censused at completion."""
+        tracer = _cfg.comm_tracer()
+        if tracer is None:
+            return self._p2p_recv(src, dst, tag, None)
+        meter = tracer.begin(self, dst, "p2p_recv", ("p2p_recv", tag),
+                             None, peer=src, tag=tag)
+        try:
+            out = self._p2p_recv(src, dst, tag, meter)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            tracer.commit(meter, error=e)
+            raise
+        tracer.commit(meter, result_payload=out)
+        return out
+
+    def _p2p_recv(self, src: int, dst: int, tag: int, meter) -> Any:
         if not (0 <= src < self.size):
             raise CommError(f"invalid source rank {src} (size {self.size})")
         q = self._mailbox(src, dst, tag)
@@ -569,8 +639,7 @@ class World:
                     if attempt < retries:
                         attempt += 1
                         if self._redeliver_dropped(src, dst, tag):
-                            with self._err_lock:
-                                self.retry_events += 1
+                            self._count_retries(1, meter)
                         deadline = time.monotonic() + _backoff_pause(
                             attempt, backoff, self.timeout)
                         continue
@@ -749,6 +818,15 @@ def run_ranks(fn: Callable, nranks: int, timeout: Optional[float] = None,
                 results[rank] = fn(rank) if nparams >= 1 else fn()
             except BaseException as e:  # noqa: BLE001 — reaped below
                 errors[rank] = e
+                tracer = _cfg.comm_tracer()
+                if tracer is not None:
+                    # Flight recorder (mpi4torch_tpu.obs): failures that
+                    # surface OUTSIDE the chokepoints (integrity guards
+                    # run on the decoded list after the rendezvous
+                    # returns) still get a rank-attributed postmortem —
+                    # this reaper is the one site that sees every rank
+                    # failure with its world identity.
+                    tracer.note_rank_failure(world, rank, e)
                 world.fail(e)
 
     threads = [threading.Thread(target=worker, args=(r,), name=f"rank{r}")

@@ -246,15 +246,22 @@ def lookup(collective: str, dtype, nbytes: int, nranks: int,
     """The cached entry for this key, or None.  Entries naming an
     algorithm (or reshard strategy) the owning registry no longer knows
     (stale cache across versions) are ignored."""
+    from ..obs import metrics as _metrics
+
     _load()
     ent = _mem.get(make_key(collective, dtype, nbytes, nranks, platform,
                             codec=codec, transition=transition))
     if ent is None:
+        _metrics.inc("tune_cache_misses_total",
+                     help="autotuner cache lookups that found no winner")
         return None
     try:
         _validate_winner(collective, ent["algorithm"])
     except (ValueError, KeyError, TypeError):
+        _metrics.inc("tune_cache_misses_total")
         return None
+    _metrics.inc("tune_cache_hits_total",
+                 help="autotuner cache lookups serving a cached winner")
     return ent
 
 

@@ -53,9 +53,13 @@ def bucket_scope(op: str, index: int, total: int, codec=None, phase=None):
     timeline shows under the ``.wait`` span itself, where the program
     had nothing else to run).  The blocking path's unsuffixed bucket
     spans are 100% exposed by construction, which is what
-    ``bench._bench_overlap_zero`` quantifies wall-clock-side."""
-    import jax
+    ``bench._bench_overlap_zero`` quantifies wall-clock-side.
 
+    With a comm tracer installed (mpi4torch_tpu.obs) the scope name is
+    additionally pushed onto the tracer's thread-local label stack, so
+    Mode B chokepoint events inside the scope carry the bucket label
+    (``jax.named_scope`` itself is invisible to the eager rendezvous);
+    without a tracer the push is skipped entirely."""
     name = f"mpi4torch.{op}.bucket{index}of{total}"
     if codec is not None:
         name += f".{codec.name}"
@@ -65,7 +69,7 @@ def bucket_scope(op: str, index: int, total: int, codec=None, phase=None):
                 f"bucket_scope phase must be 'start' or 'wait', got "
                 f"{phase!r}")
         name += f".{phase}"
-    return jax.named_scope(name)
+    return _labeled_scope(name)
 
 
 def serve_step_scope(what: str = "decode_step"):
@@ -78,9 +82,20 @@ def serve_step_scope(what: str = "decode_step"):
     ``mpi4torch.serve.decode_step/.../mpi4torch.ServeDecode.bucket<i>of
     <n>.<phase>/...``), and profiler traces separate prefill spans from
     decode spans per engine step."""
+    return _labeled_scope(f"mpi4torch.serve.{what}")
+
+
+@contextlib.contextmanager
+def _labeled_scope(name: str):
+    """``jax.named_scope(name)`` plus the obs label-stack push (a no-op
+    when no comm tracer is installed — the scopes stay free with
+    observability off)."""
     import jax
 
-    return jax.named_scope(f"mpi4torch.serve.{what}")
+    from ..obs.trace import push_label
+
+    with push_label(name), jax.named_scope(name):
+        yield
 
 
 class ServeStats:
@@ -144,7 +159,13 @@ class ServeStats:
                 self.spans.pop(next(iter(self.spans)))
 
     def snapshot(self) -> dict:
-        """Counters + derived occupancy and latency aggregates."""
+        """Counters + derived occupancy and latency aggregates.  The
+        latency dicts carry mean/max plus p50/p99 via the ONE shared
+        percentile rule (:func:`mpi4torch_tpu.obs.percentile` — the
+        same nearest-rank-floor rule bench.py's serve stanza uses, so
+        "p99" means one thing repo-wide)."""
+        from ..obs.metrics import percentile
+
         with self._lock:
             counters = dict(self.counters)
             spans = {rid: dict(s) for rid, s in self.spans.items()}
@@ -159,37 +180,37 @@ class ServeStats:
         out["n_requests_tracked"] = len(spans)
         if ttft:
             out["ttft_s"] = {"mean": sum(ttft) / len(ttft),
-                             "max": max(ttft)}
+                             "max": max(ttft),
+                             "p50": percentile(ttft, 0.50),
+                             "p99": percentile(ttft, 0.99)}
         if e2e:
-            out["e2e_s"] = {"mean": sum(e2e) / len(e2e), "max": max(e2e)}
+            out["e2e_s"] = {"mean": sum(e2e) / len(e2e), "max": max(e2e),
+                            "p50": percentile(e2e, 0.50),
+                            "p99": percentile(e2e, 0.99)}
         return out
 
 
 # Weak references: an engine holds the only strong reference to its
 # ServeStats, so a discarded engine drops out of the aggregate (and out
 # of memory) instead of being summed forever by an append-only list.
-_serve_registry = []
-_serve_registry_lock = threading.Lock()
+# The registry implementation is the shared obs one
+# (mpi4torch_tpu.obs.metrics.StatsSourceRegistry — re-homed there so
+# there is ONE weakref-source registry in the repo, not a private copy
+# per subsystem); these shims keep the historical entry points and
+# semantics bit-for-bit.
+_SERVE_GROUP = "serve"
 
 
 def _register_serve_stats(stats: ServeStats) -> ServeStats:
-    import weakref
+    from ..obs.metrics import sources
 
-    with _serve_registry_lock:
-        _serve_registry.append(weakref.ref(stats))
-    return stats
+    return sources().register(_SERVE_GROUP, stats)
 
 
 def _live_serve_stats():
-    with _serve_registry_lock:
-        live, keep = [], []
-        for ref in _serve_registry:
-            obj = ref()
-            if obj is not None:
-                live.append(obj)
-                keep.append(ref)
-        _serve_registry[:] = keep   # prune dead engines' slots
-    return live
+    from ..obs.metrics import sources
+
+    return sources().live(_SERVE_GROUP)
 
 
 def serve_stats() -> dict:
@@ -218,11 +239,22 @@ def reset_serve_stats() -> None:
     reset keep counting on their own (now zeroed) ``stats`` object but
     drop out of the process aggregate — a reset mid-flight is a
     bookkeeping cut, not an engine restart."""
-    engines = _live_serve_stats()
-    with _serve_registry_lock:
-        _serve_registry.clear()
-    for e in engines:
+    from ..obs.metrics import sources
+
+    for e in sources().clear(_SERVE_GROUP):
         e.reset()
+
+
+# Serving counters in the unified metrics namespace: a snapshot-time
+# collector (the engines already keep the live state; obs polls it)
+# rather than a second copy of every counter.
+def _register_serve_collector() -> None:
+    from ..obs.metrics import register_collector
+
+    register_collector("serve", serve_stats)
+
+
+_register_serve_collector()
 
 
 @contextlib.contextmanager
