@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -139,6 +139,23 @@ obs-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.obs --smoke
+
+# CPU smoke run of the elastic world-resize runtime
+# (mpi4torch_tpu.elastic): the full censused matrix — rank_death and
+# preempt (advance-notice) failures across the plain / ZeRO / MoE /
+# serve subsystems under shrink ((8,)->(6,); serve (4,)->(2,)),
+# grow-after-shrink round-trips, and hot-spare takeover — every cell
+# ending recovered-and-BITWISE against the fresh-start oracle on the
+# new world (fired-fault ledger proven) or in its typed,
+# rank-attributed raise, plus the membership-consensus failure cells
+# (injected disagreement -> ConsensusError naming the id; a rank dying
+# mid-consensus -> attributed RankFailedError) and the registry-sync
+# guard.  Exits non-zero on any hang-shaped failure, unattributed
+# error, non-bitwise recovery, or unfired cell.
+elastic-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.elastic --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -772,6 +772,105 @@ def _bench_reshard(on_tpu: bool):
     return res
 
 
+def _bench_elastic(on_tpu: bool):
+    """Elastic world-resize stanza (ISSUE 13): the deterministic
+    wire-bytes census of the shrink replan vs the full-restart restore,
+    plus wall-clock of a live (8,)->(6,) drain on the thread world.
+
+    The comparison is the planner's own per-device accounting
+    (reshard.plan_resize: the same ``_estimates`` currency every
+    reshard number uses): ``planned`` is the auto-selected live-drain
+    program (chunk-permute rounds — O(moved chunks) wire), ``restart``
+    is the ``gather`` strategy (every rank re-materializes the full
+    state then slices — exactly what a naive full-job restart's
+    restore does on the wire).  The verdict
+    ``replan_cheaper_than_restart`` is deterministic; wall-clock rides
+    alongside (Mode B rendezvous — scheduler noise on CPU, the census
+    is the headline)."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu import reshard as rs
+    from mpi4torch_tpu.elastic import (ElasticRuntime, replan_axis0)
+
+    W, M = 8, 6
+    # A representative re-layed state set: a TP head bank + two ZeRO
+    # flat leaves (the elastic matrix's shapes, scaled up).
+    states = {
+        "tp_bank": (48, (256,)),       # 48 heads x 256 f32
+        "zero_w": (12 * 4096, ()),     # flat padded elements
+        "zero_b": (4096, ()),
+    }
+    embed_from = tuple(range(W))
+    embed_to = tuple(range(M))
+    table = {}
+    planned_wire = restart_wire = 0
+    planned_peak = restart_peak = 0
+    for name, (n, row) in states.items():
+        p = rs.plan_resize(n, row, W, M, np.float32,
+                           embed_from=embed_from, embed_to=embed_to,
+                           exec_size=W)
+        g = rs.plan_resize(n, row, W, M, np.float32,
+                           embed_from=embed_from, embed_to=embed_to,
+                           exec_size=W, strategy="gather")
+        table[name] = {
+            "planned_strategy": p.strategy,
+            "planned_wire_bytes": p.wire_bytes,
+            "planned_peak_bytes": p.peak_bytes,
+            "restart_wire_bytes": g.wire_bytes,
+            "restart_peak_bytes": g.peak_bytes,
+        }
+        planned_wire += p.wire_bytes
+        restart_wire += g.wire_bytes
+        planned_peak = max(planned_peak, p.peak_bytes)
+        restart_peak = max(restart_peak, g.peak_bytes)
+
+    # Wall-clock: one live drain of the TP bank on the thread world,
+    # planned vs gather (same data, same embeds, same world).
+    n, row = states["tp_bank"]
+    per = n // W
+    bank = np.arange(n * row[0], dtype=np.float32).reshape((n,) + row)
+    wall = {}
+    for label, strategy in (("planned", None), ("restart", "gather")):
+        rt = ElasticRuntime(W, probe_timeout=0.5, world_timeout=30.0)
+        view0 = rt.view
+
+        def drain_body(pos, rid, old_view, new_view, strategy=strategy):
+            x = jnp.asarray(bank[pos * per:(pos + 1) * per])
+            return np.asarray(replan_axis0(
+                mpi.COMM_WORLD, x, n, old_view, new_view,
+                mode="drain", strategy=strategy))
+
+        t0 = _time.perf_counter()
+        outs = rt.drain(drain_body, leaving=[6, 7])
+        wall[label] = _time.perf_counter() - t0
+        per_m = n // M
+        for j, rid in enumerate(rt.view.alive):
+            assert np.array_equal(outs[view0.position(rid)],
+                                  bank[j * per_m:(j + 1) * per_m]), \
+                f"{label} drain diverged"
+
+    return {
+        "worlds": f"({W},)->({M},)",
+        "table": table,
+        "planned_wire_bytes_total": planned_wire,
+        "restart_wire_bytes_total": restart_wire,
+        "wire_advantage": round(restart_wire / max(planned_wire, 1), 3),
+        "planned_peak_bytes_max": planned_peak,
+        "restart_peak_bytes_max": restart_peak,
+        "replan_cheaper_than_restart": bool(
+            planned_wire < restart_wire
+            and planned_peak < restart_peak),
+        "drain_seconds": wall,
+        "note": ("census = reshard plan accounting (deterministic); "
+                 "wall-clock is Mode B rendezvous incl. the consensus "
+                 "round"),
+    }
+
+
 def _bench_allreduce_fused(on_tpu: bool):
     """Fused bucketed vs per-leaf Allreduce on a real DP ResNet gradient
     tree (mpi4torch_tpu.fuse, ISSUE 2): collective-launch counts read off
@@ -1852,6 +1951,7 @@ def main() -> None:
         gov = _guarded("guard_overhead", _bench_guard_overhead, on_tpu)
         obsov = _guarded("obs_overhead", _bench_obs_overhead, on_tpu)
         rsh = _guarded("reshard", _bench_reshard, on_tpu)
+        ela = _guarded("elastic", _bench_elastic, on_tpu)
         srv = _guarded("serve", _bench_serve, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
@@ -1889,6 +1989,7 @@ def main() -> None:
             "guard_overhead": gov,
             "obs_overhead": obsov,
             "reshard": rsh,
+            "elastic": ela,
             "serve": srv,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,

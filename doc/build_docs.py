@@ -26,7 +26,8 @@ DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism", "serving",
          "compression", "fusion", "algorithms", "overlap", "resilience",
-         "reshard", "analysis", "observability", "api_reference",
+         "reshard", "elasticity", "analysis", "observability",
+         "api_reference",
          "design_tpu", "glossary"]
 
 CSS = """

@@ -72,6 +72,7 @@ from . import reshard
 from . import serve
 from . import analyze
 from . import obs
+from . import elastic
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
@@ -123,6 +124,7 @@ __all__ = [
     "serve",
     "analyze",
     "obs",
+    "elastic",
     "SpmdWaitHandle",
     "FaultPlan",
     "FaultSpec",

@@ -29,6 +29,7 @@ from typing import Iterable, List, Optional, Set
 __all__ = [
     "set_drift",
     "resilience_problems",
+    "elastic_problems",
     "reshard_step_problems",
     "serve_policy_problems",
     "tune_problems",
@@ -79,6 +80,51 @@ def resilience_problems() -> List[str]:
         if rows and all(v == "inert" for v in rows.values()):
             problems.append(f"{kind}: inert in EVERY subsystem — the "
                             "kind is effectively untested")
+    return problems
+
+
+# ---------------------------------------------------------------- elastic
+
+def elastic_problems() -> List[str]:
+    """Elastic matrix coverage vs its declared dimensions, and the
+    bridge into the resilience registry: every failure kind the elastic
+    matrix composes must itself be a registered fault kind with a plain
+    fault-matrix row (the preempt satellite's guard)."""
+    from ..elastic.matrix import (ACTIONS, CONSENSUS_COVERAGE, COVERAGE,
+                                  EXPECTED_CONSENSUS_ERROR, KINDS,
+                                  SUBSYSTEMS)
+    from ..resilience.faults import FAULT_KINDS
+    from ..resilience.matrix import COVERAGE as FAULT_COVERAGE
+
+    declared = {(k, s, a) for k in KINDS for s in SUBSYSTEMS
+                for a in ACTIONS}
+    problems = set_drift(
+        declared, set(COVERAGE),
+        "elastic coverage drift: declared cells {registered} vs "
+        "COVERAGE table {covered} — every (kind x subsystem x action) "
+        "needs a cell and vice versa")
+    for kind in KINDS:
+        if kind not in FAULT_KINDS:
+            problems.append(
+                f"elastic kind {kind!r} is not a registered fault kind "
+                "— register it (resilience.faults) so the injection "
+                "grammar covers it")
+        elif kind not in FAULT_COVERAGE:
+            problems.append(
+                f"elastic kind {kind!r} has no plain fault-matrix row — "
+                "the resilience matrix must pin its unhandled "
+                "(raise) behavior before the elastic matrix composes "
+                "its handled one")
+    problems += set_drift(
+        CONSENSUS_COVERAGE, {(k, "membership", "consensus")
+                             for k in EXPECTED_CONSENSUS_ERROR},
+        "consensus-cell drift: coverage {registered} vs expected-error "
+        "table {covered}")
+    bad = [v for v in list(COVERAGE.values())
+           + list(CONSENSUS_COVERAGE.values())
+           if v not in ("recover", "raise")]
+    if bad:
+        problems.append(f"unknown elastic cell outcomes {sorted(set(bad))}")
     return problems
 
 
@@ -197,6 +243,7 @@ def standing_problems() -> List[str]:
     runs this, so a drift in ANY subsystem registry fails the
     ``make analyze-smoke`` lane too."""
     problems = [f"resilience: {p}" for p in resilience_problems()]
+    problems += [f"elastic: {p}" for p in elastic_problems()]
     problems += [f"reshard: {p}" for p in reshard_step_problems()]
     from ..serve.__main__ import PARITY_POLICIES
     problems += [f"serve: {p}"

@@ -1,0 +1,69 @@
+"""mpi4torch_tpu.elastic — live world resize: shrink, grow, takeover.
+
+ROADMAP item 4, the composition of the PR 7 and PR 8 halves: failures
+are already *attributed* (``RankFailedError.ranks``, ``check_health``
+probes) and state already re-lays onto any topology as memory-bounded
+portable-collective plans (``reshard``).  This package wires them into
+a runtime that survives membership changes without a full-job restart:
+
+* :mod:`.membership` — ``WorldView(epoch, alive, mesh_shape)`` and the
+  probe-then-ratify consensus (``agree_world_view``): survivors agree
+  on the next membership, a monotonically increasing epoch fences
+  stale traffic (consensus tags, checkpoint stamps, driver-side
+  :class:`~.membership.StaleEpochError`), and disagreement or a second
+  failure mid-round ends in a typed, rank-attributed raise — never a
+  hang.
+* :mod:`.replan` — replan-as-reshard: every state kind re-lays through
+  :func:`mpi4torch_tpu.reshard.plan_resize` (the cross-world-size
+  planner in the PR 8 step grammar: adjoint = the reverse resize, VJP
+  intact), ZeRO shards and TP heads and MoE expert stacks alike; serve
+  traffic drains to tickets and re-admits through the engine's
+  admission POLICIES with token streams bitwise vs ``generate()``.
+* :mod:`.spare` — hot-spare ranks riding the existing collectives to
+  keep full replicas of the sharded state current at zero extra wire,
+  for zero-reshard takeover (fallback: the planned drain).
+* :mod:`.runtime` — :class:`~.runtime.ElasticRuntime`, the phase
+  driver (run phase → observe failure/notice → consensus → replan →
+  resume).
+* :mod:`.matrix` — the censused (failure kind × subsystem × action)
+  matrix: every cell recovered-and-bitwise vs the fresh-start oracle
+  on the new world or a typed attributed raise, fired-fault-ledger
+  proven (``make elastic-smoke``).
+
+See ``doc/elasticity.md``.
+"""
+
+from .membership import (ConsensusError, ElasticError, StaleEpochError,
+                         WorldView, agree_world_view, fence_tag,
+                         initial_view)
+from .replan import (ServeTicket, drain_tickets, readmit, replan_axis0,
+                     replan_axis0_tree, replan_zero, resize_embeds,
+                     stitched_results)
+from .runtime import ElasticRuntime
+from .spare import (bank_spare_step, is_spare, takeover_bank_slot,
+                    takeover_shard, zero_spare_init, zero_spare_step)
+
+__all__ = [
+    "WorldView",
+    "ElasticError",
+    "ConsensusError",
+    "StaleEpochError",
+    "agree_world_view",
+    "fence_tag",
+    "initial_view",
+    "ElasticRuntime",
+    "resize_embeds",
+    "replan_axis0",
+    "replan_axis0_tree",
+    "replan_zero",
+    "ServeTicket",
+    "drain_tickets",
+    "readmit",
+    "stitched_results",
+    "is_spare",
+    "zero_spare_init",
+    "zero_spare_step",
+    "takeover_shard",
+    "bank_spare_step",
+    "takeover_bank_slot",
+]

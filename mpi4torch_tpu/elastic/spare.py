@@ -1,0 +1,217 @@
+"""Hot-spare ranks: replicated state slices for zero-reshard takeover.
+
+A spare is a world member that computes no gradients but RIDES the
+training step's existing collectives, keeping a full replica of the
+sharded state current at zero extra wire bytes — on the Mode B
+rendezvous every collective already delivers each rank the material it
+needs (an ``Allreduce`` hands every member the full fold), so the
+spare's mirror is pure local post-processing of wire traffic the data
+ranks were exchanging anyway.  When a data rank dies, the spare
+promotes into its deal slot by SLICING its mirror — no reshard plan, no
+wire, no checkpoint rewind: the zero-reshard takeover the elastic
+matrix's ``spare`` cells certify bitwise.  When no spare is available,
+recovery falls back to the planned resharding of :mod:`.replan` (and,
+for a no-notice death, the epoch-stamped checkpoint rewind) — the
+documented fallback the matrix also exercises.
+
+Conventions:
+
+* a spare world has ``n_data`` data ranks at positions ``0..n_data-1``
+  and the spares ABOVE them (positions ``n_data..``) — the deal width
+  is ``n_data``, decoupled from the world size, which is what makes
+  same-width takeover possible at all;
+* data ranks keep shard-sized state (``slot = position``); spares keep
+  the full mirror (``slot = None``) — the spare pays replicated-state
+  memory, which is its job;
+* spares contribute ZEROS to the gradient collectives, so they are
+  arithmetically invisible under SUM reduction (the elastic bitwise
+  discipline) while completing every rendezvous.
+
+On Mode A the same recipe costs real wire (an all-reduce where a
+reduce-scatter would do); the mirror is a Mode B / host-runtime
+feature by design — production spares would pin HBM replicas the same
+way, trading memory and wire for instant takeover.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..constants import MPI_SUM
+from .membership import ElasticError
+
+__all__ = [
+    "is_spare",
+    "zero_spare_init",
+    "zero_spare_step",
+    "takeover_shard",
+    "bank_spare_step",
+    "takeover_bank_slot",
+]
+
+
+def is_spare(position: int, n_data: int) -> bool:
+    return position >= n_data
+
+
+def _flat_pad(x, n_data: int):
+    import jax.numpy as jnp
+
+    flat = jnp.asarray(x).reshape(-1)
+    per = -(-flat.shape[0] // n_data)
+    return jnp.pad(flat, (0, per * n_data - flat.shape[0])), per
+
+
+def _seg(flat, per: int, slot: int):
+    return flat[slot * per:(slot + 1) * per]
+
+
+def zero_spare_init(opt, params, n_data: int, slot: Optional[int]):
+    """Optimizer state for a spare-capable ZeRO world: data rank
+    ``slot`` inits on its ``1/n_data`` flat segment, a spare
+    (``slot=None``) on the FULL padded flat view — elementwise
+    optimizers make the mirror's segment ``s`` bitwise identical to
+    data rank ``s``'s state forever after."""
+    import jax
+
+    def view(p):
+        flat, per = _flat_pad(p, n_data)
+        return flat if slot is None else _seg(flat, per, slot)
+
+    return opt.init(jax.tree.map(view, params))
+
+
+def zero_spare_step(comm, opt, params, local_grads, opt_state, *,
+                    n_data: int, slot: Optional[int], slots=None):
+    """One spare-capable ZeRO step; every world member (data ranks AND
+    spares) calls it collectively.  Returns ``(new_params,
+    new_opt_state)`` — parameters fully replicated (as in ZeRO-1), the
+    optimizer state shard-sized on data ranks and full on spares.
+
+    ``slot`` is THIS rank's data deal slot (``None`` for a mirror);
+    ``slots`` maps every world position to its slot (``None`` entries
+    for spares) — required once takeover has permuted slots relative
+    to world positions (a promoted spare carries the dead rank's slot
+    from whatever position its stable id sorts to); the default is the
+    identity convention (position ``p`` < ``n_data`` serves slot
+    ``p``, spares above).
+
+    Wire per step: ONE summed gradient all-reduce (spares contribute
+    zeros — invisible under SUM) + ONE segment all-gather of the
+    updated parameters (spares contribute an inert zeros segment,
+    discarded by slot bookkeeping).  On the rendezvous backend that is
+    the same wire the plain ZeRO-1 step pays; the spare's full-gradient
+    view is local post-processing of the first collective — the
+    piggyback."""
+    import jax
+    import jax.numpy as jnp
+
+    size = comm.size
+    if not (0 < n_data <= size):
+        raise ElasticError(
+            f"n_data must be in 1..world size ({size}); got {n_data}")
+    if slot is not None and not (0 <= slot < n_data):
+        raise ElasticError(
+            f"data slot must be in 0..{n_data - 1}; got {slot}")
+    if slots is None:
+        slots = tuple(p if p < n_data else None for p in range(size))
+    slots = tuple(slots)
+    if len(slots) != size or sorted(
+            s for s in slots if s is not None) != list(range(n_data)):
+        raise ElasticError(
+            f"slots must map the {size} world positions onto data "
+            f"slots 0..{n_data - 1} (spares None); got {slots}")
+    pos_of_slot = {s: p for p, s in enumerate(slots) if s is not None}
+
+    # Wire 1: the full global gradient on every member.  compression
+    # explicitly off — the mirror must hold the exact bits the owners
+    # hold.
+    g_full = jax.tree.map(
+        lambda g: comm.Allreduce(jnp.asarray(g), MPI_SUM,
+                                 compression=False),
+        local_grads)
+
+    def view(x):
+        flat, per = _flat_pad(x, n_data)
+        return flat if slot is None else _seg(flat, per, slot)
+
+    p_view = jax.tree.map(view, params)
+    g_view = jax.tree.map(view, g_full)
+    pers = jax.tree.map(lambda p: _flat_pad(p, n_data)[1], params)
+    updates, new_state = opt.update(g_view, opt_state, p_view)
+    p_view = jax.tree.map(jnp.add, p_view, updates)
+
+    # Wire 2: segment all-gather back to full replicated parameters.
+    # Every member contributes a segment-shaped buffer (spares: zeros,
+    # sliced away by position below), so the collective signature is
+    # uniform across the world.
+    def gather_leaf(pv, per, tmpl):
+        contrib = pv if slot is not None else jnp.zeros((per,), pv.dtype)
+        full = comm.Allgather(contrib, 0, compression=False)
+        # Reassemble in SLOT order, not position order: takeover may
+        # have permuted who serves which slot.
+        flat = jnp.concatenate([
+            full[pos_of_slot[s] * per:(pos_of_slot[s] + 1) * per]
+            for s in range(n_data)])
+        n = int(np.prod(np.shape(tmpl))) if np.shape(tmpl) else 1
+        return flat[:n].reshape(np.shape(tmpl))
+
+    # The gathered copy is the source of truth for everyone — on a
+    # spare it is bitwise the segments of its own full update (same
+    # elements through the same elementwise ops; the matrix's spare
+    # cells pin that), so data ranks and mirrors replicate identically.
+    new_params = jax.tree.map(gather_leaf, p_view, pers, params)
+    return new_params, new_state
+
+
+def takeover_shard(full_state, slot: int, n_data: int, template):
+    """Zero-reshard takeover: slice data slot ``slot``'s shard out of a
+    spare's FULL mirror state — the promoted spare's state in the new
+    world, bitwise what the dead rank held.  ``template`` gives each
+    leaf's global shape (the same convention as
+    :func:`.replan.replan_zero`)."""
+    import jax
+
+    def one(full_flat, tmpl):
+        n = int(np.prod(np.shape(tmpl))) if np.shape(tmpl) else 1
+        per = -(-n // n_data)
+        return _seg(full_flat, per, slot)
+
+    return jax.tree.map(one, full_state, template)
+
+
+# ---------------------------------------------------------------------------
+# Dense / TP bank mirror: the same discipline for axis-0-sharded state.
+# ---------------------------------------------------------------------------
+
+
+def bank_spare_step(comm, bank, delta, *, n_data: int,
+                    slot: Optional[int]):
+    """One update of an axis-0-sharded parameter bank with a spare
+    mirror: every member contributes its (zero-padded, full-shaped)
+    ``delta`` to ONE summed all-reduce; data rank ``slot`` applies its
+    axis-0 slice, a spare applies the whole thing to its full replica.
+    Returns the updated shard (data) or full bank (spare)."""
+    import jax.numpy as jnp
+
+    d = comm.Allreduce(jnp.asarray(delta), MPI_SUM, compression=False)
+    if slot is None:
+        return jnp.asarray(bank) + d
+    n_units = d.shape[0]
+    if n_units % n_data:
+        raise ElasticError(
+            f"bank axis 0 ({n_units}) must divide by n_data ({n_data})")
+    per = n_units // n_data
+    return jnp.asarray(bank) + d[slot * per:(slot + 1) * per]
+
+
+def takeover_bank_slot(full_bank, slot: int, n_data: int):
+    """Slice data slot ``slot``'s axis-0 shard from a spare's full bank
+    replica (the dense analogue of :func:`takeover_shard`)."""
+    import jax.numpy as jnp
+
+    bank = jnp.asarray(full_bank)
+    per = bank.shape[0] // n_data
+    return bank[slot * per:(slot + 1) * per]

@@ -177,12 +177,21 @@ class MetricsRegistry:
         snap = self.snapshot()
         lines: List[str] = []
 
+        seen_headers = set()
+
         def emit(name, kind, value):
+            # A name may carry a Prometheus label set (`..._total{result=
+            # "ok"}` — the health-probe counters): the sample line keeps
+            # it, the HELP/TYPE headers use the bare metric name (and are
+            # emitted once per family, not once per label value).
             full = PROM_PREFIX + name
-            doc = self._help.get(name)
-            if doc:
-                lines.append(f"# HELP {full} {doc}")
-            lines.append(f"# TYPE {full} {kind}")
+            bare = full.split("{", 1)[0]
+            if bare not in seen_headers:
+                seen_headers.add(bare)
+                doc = self._help.get(name)
+                if doc:
+                    lines.append(f"# HELP {bare} {doc}")
+                lines.append(f"# TYPE {bare} {kind}")
             lines.append(f"{full} {value:g}")
 
         for name in sorted(snap["counters"]):

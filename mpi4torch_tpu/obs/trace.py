@@ -58,9 +58,15 @@ _FAILURE_TYPES = None
 def _failure_types():
     global _FAILURE_TYPES
     if _FAILURE_TYPES is None:
+        from ..elastic.membership import ConsensusError
         from ..runtime import (DeadlockError, IntegrityError,
                                RankFailedError)
-        _FAILURE_TYPES = (RankFailedError, DeadlockError, IntegrityError)
+        # ConsensusError rides the same reaper entry point every other
+        # attributed failure does (run_ranks routes rank failures to
+        # note_rank_failure) — a failed resize gets its flight-recorder
+        # postmortem with zero new hooks.
+        _FAILURE_TYPES = (RankFailedError, DeadlockError, IntegrityError,
+                          ConsensusError)
     return _FAILURE_TYPES
 
 

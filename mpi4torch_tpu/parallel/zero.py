@@ -88,8 +88,16 @@ def zero_init(comm, opt, params):
 
 
 def zero_step(comm, opt, params, local_grads, opt_state,
-              grad_transform=None, overlap=None):
+              grad_transform=None, overlap=None, mean=True):
     """One ZeRO-1 update; returns ``(new_params, new_opt_state)``.
+
+    ``mean=False`` keeps the rank-SUM gradient instead of the rank
+    mean.  The elastic round-trip discipline wants this
+    (mpi4torch_tpu.elastic): a SUM of per-sample gradients is the same
+    number regardless of how many ranks deal the same global batch,
+    while ``/6`` vs ``/8`` of it are different floats — so a job that
+    must stay bitwise across a shrink/grow uses SUM reduction with the
+    batch-size normalization folded into its loss or learning rate.
 
     ``local_grads`` are this rank's UN-reduced loss gradients (their sum
     over ranks is the global gradient — e.g. ``jax.grad`` of the local
@@ -123,7 +131,7 @@ def zero_step(comm, opt, params, local_grads, opt_state,
     # backend, ~n_leaves/n_buckets fewer launches on both.
     from ..fuse import fused_reduce_scatter_tree
     g_shards = fused_reduce_scatter_tree(comm, local_grads, MPI_SUM,
-                                         mean=True, overlap=overlap)
+                                         mean=mean, overlap=overlap)
     if grad_transform is not None:
         g_shards = grad_transform(g_shards)
     p_shards = zero3_shard_params(comm, params)

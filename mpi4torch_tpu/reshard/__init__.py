@@ -24,11 +24,12 @@ reshard-smoke`).
 """
 
 from .census import peak_live_bytes, tensor_bytes
-from .executor import (execute_plan, gather_then_slice, global_template,
-                       reshard_blocks, reshard_tree, reshard_value,
-                       shard_of, shard_template, slice_shard)
+from .executor import (apply_plan, execute_plan, gather_then_slice,
+                       global_template, reshard_blocks, reshard_tree,
+                       reshard_value, shard_of, shard_template,
+                       slice_shard)
 from .plan import (STEP_KINDS, STRATEGIES, Layout, ReshardPlan, layout,
-                   plan_permutation, plan_reshard)
+                   plan_permutation, plan_reshard, plan_resize)
 from .rules import match_partition_rules, tree_paths
 
 __all__ = [
@@ -39,6 +40,8 @@ __all__ = [
     "STRATEGIES",
     "plan_reshard",
     "plan_permutation",
+    "plan_resize",
+    "apply_plan",
     "execute_plan",
     "reshard_value",
     "reshard_tree",

@@ -32,8 +32,9 @@ from .plan import (Layout, ReshardPlan, _MOVE_KINDS, plan_permutation,
                    plan_reshard)
 
 __all__ = [
-    "execute_plan", "reshard_value", "reshard_tree", "gather_then_slice",
-    "slice_shard", "shard_of", "shard_template", "global_template",
+    "apply_plan", "execute_plan", "reshard_value", "reshard_tree",
+    "gather_then_slice", "slice_shard", "shard_of", "shard_template",
+    "global_template",
 ]
 
 
@@ -434,6 +435,20 @@ def _apply_plan_vjp(comm, plan: ReshardPlan, x, codec):
 
     f.defvjp(lambda v: (execute_plan(comm, plan, v, codec), None), bwd)
     return f(x)
+
+
+def apply_plan(comm, plan: ReshardPlan, x, *, differentiable=True):
+    """Execute an already-compiled :class:`ReshardPlan` on ``comm`` —
+    the entry the elastic resize plans use (:func:`~mpi4torch_tpu.
+    reshard.plan_resize` builds plans outside the Layout-pair facade,
+    so there is no from/to spec to re-derive them from).
+    ``differentiable=True`` wraps the execution in the standard
+    custom_vjp whose backward runs ``plan.adjoint()`` — for a resize
+    plan that reverse IS the grow-back (or re-shrink) program, so
+    training graphs that cross a resize stay AD-transparent."""
+    if differentiable:
+        return _apply_plan_vjp(comm, plan, x, None)
+    return execute_plan(comm, plan, x)
 
 
 def reshard_value(comm, x, from_spec, to_spec, strategy=None,

@@ -655,7 +655,7 @@ def _build_rounds(routes: _Routes):
     return tuple(steps)
 
 
-def _build_gather(src_lay: Layout, routes: _Routes):
+def _build_gather(routes: _Routes):
     """The gather-then-slice baseline: stack every rank's shard (the
     full array lives on every rank — the peak the planner exists to
     avoid), then slice the target shard from the stack.  Kept as the
@@ -733,10 +733,16 @@ def _assemble(steps, strategy, size, routes, dtype, transition):
                        transition=transition)
 
 
-def _candidates(src_lay, dst_lay, global_shape, routes):
+def _candidates(src_lay, dst_lay, global_shape, routes,
+                with_gather=None):
     """(strategy, steps) for every applicable strategy, in auto
     preference order (cheapest peak memory first; ``gather`` last and
-    never auto-picked)."""
+    never auto-picked).  ``with_gather`` overrides the historical
+    src_lay-presence gate (resize routes have no source Layout but DO
+    want the gather baseline — it is the full-restart oracle the bench
+    compares the live replan against)."""
+    if with_gather is None:
+        with_gather = src_lay is not None
     out = []
     for name in STRATEGIES:
         if name == "local":
@@ -751,8 +757,7 @@ def _candidates(src_lay, dst_lay, global_shape, routes):
         elif name == "rounds":
             steps = _build_rounds(routes)
         else:
-            steps = (_build_gather(src_lay, routes)
-                     if src_lay is not None else None)
+            steps = _build_gather(routes) if with_gather else None
         if steps is not None:
             out.append((name, steps))
     return out
@@ -862,9 +867,9 @@ def plan_permutation(lay: Layout, axis: int, perm, global_shape, dtype,
     """Plan a block permutation along ``axis`` under a fixed layout —
     the MoE expert-rebalancing transition: unit ``u`` of the result
     holds old unit ``perm[u]``.  Same strategies, caching and adjoint
-    contract as :func:`plan_reshard` (``gather`` is not applicable:
-    ``_build_gather`` needs the two-layout form, and a permutation
-    never wants it)."""
+    contract as :func:`plan_reshard` (``gather`` is deliberately
+    excluded from the candidate set here — a permutation never wants
+    the full-materialization baseline)."""
     from ..tune import generation
 
     import numpy as _np
@@ -873,3 +878,145 @@ def plan_permutation(lay: Layout, axis: int, perm, global_shape, dtype,
                              tuple(int(s) for s in global_shape),
                              str(_np.dtype(dtype)),
                              _resolve_strategy(strategy), generation())
+
+
+# ---------------------------------------------------------------------------
+# Elastic world resize: axis-0 redistribution ACROSS world sizes.
+# ---------------------------------------------------------------------------
+#
+# plan_reshard deliberately refuses transitions that change the world
+# size — within one world there is nothing a size change could mean.
+# The elastic runtime (mpi4torch_tpu.elastic) needs exactly that
+# transition: state dealt over W ranks re-dealt over M ranks, executed
+# on whichever world holds both memberships (the OLD world for a
+# graceful drain — every source rank still alive — or the NEW world for
+# a grow, with the survivors embedded among the joiners).  The from/to
+# deals are the repo's standard axis-0 conventions: ``n`` leading units
+# (ZeRO's padded flat elements, TP's heads, MoE's stacked experts)
+# ceil-split into ``per = ceil(n / size)`` units per rank, the tail
+# rank zero-padded.  Because every shard boundary is a multiple of
+# ``gcd(per_from, per_to)``, chunking at that gcd puts each chunk
+# inside exactly one source shard and one target shard — the same
+# uniform-chunk _Routes the existing strategy builders and BOTH
+# executors already serve, so a resize plan is an ordinary ReshardPlan:
+# permute/alltoall/rounds candidates, the gather baseline (= the
+# full-restart restore every rank re-materializes — the bench's
+# comparison), adjoint() = the reverse (grow-back) plan, and the
+# custom_vjp discipline via executor.apply_plan.
+
+
+def _resize_routes(n: int, row: Tuple[int, ...], from_size: int,
+                   to_size: int, embed_from, embed_to,
+                   exec_size: int) -> _Routes:
+    per_f = -(-n // from_size)
+    per_t = -(-n // to_size)
+    c = math.gcd(per_f, per_t)
+    nd = 1 + len(row)
+    in_shape = (per_f,) + row
+    out_shape = (per_t,) + row
+    chunk = (c,) + row
+    # Route every chunk that carries logical data (start < n); chunks
+    # fully inside the padding are zeros on both sides and the output
+    # buffer starts as zeros, so routing them would be wire for nothing.
+    wants = []
+    zero_tail = (0,) * len(row)
+    for k in range(min(-(-n // c), (per_t * to_size) // c)):
+        start = k * c
+        i = start // per_f               # source deal position
+        j = start // per_t               # target deal position
+        wants.append((embed_to[j], [embed_from[i]],
+                      (start - i * per_f,) + zero_tail,
+                      (start - j * per_t,) + zero_tail))
+    return _routes_from_wants(exec_size, chunk, in_shape, out_shape,
+                              wants)
+
+
+@functools.lru_cache(maxsize=256)
+def _resize_plan_cached(n, row, from_size, to_size, embed_from,
+                        embed_to, exec_size, dtype, strategy, _gen):
+    routes = _resize_routes(n, row, from_size, to_size, embed_from,
+                            embed_to, exec_size)
+    cands = _candidates(None, None, (n,) + row, routes,
+                        with_gather=True)
+    trans = (f"resize[{from_size}->{to_size}]"
+             f"@{'x'.join(str(s) for s in (n,) + row)}"
+             f"/exec{exec_size}:{_fnv_embed(embed_from, embed_to)}")
+    import numpy as _np
+
+    nbytes = int(math.prod(routes.in_shape)) * _np.dtype(dtype).itemsize
+    if strategy is None:
+        name = _pick(cands, dtype, nbytes, exec_size, trans)
+    else:
+        name = strategy
+        if name not in [nm for nm, _ in cands]:
+            raise CommError(
+                f"reshard strategy {name!r} cannot serve the resize "
+                f"{trans} (applicable: {[nm for nm, _ in cands]})")
+    steps = dict(cands)[name]
+    return _assemble(steps, name, exec_size, routes, dtype, trans)
+
+
+def _fnv_embed(embed_from, embed_to) -> str:
+    """Short stable fingerprint of the embedding maps for the
+    transition key (full tuples would make tune-cache keys unwieldy on
+    big worlds)."""
+    h = 0x811C9DC5
+    for v in (*embed_from, -1, *embed_to):
+        h ^= (v + 2) & 0xFF
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return f"{h:08x}"
+
+
+def plan_resize(n: int, row_shape, from_size: int, to_size: int, dtype,
+                *, embed_from, embed_to, exec_size: int,
+                strategy=None) -> ReshardPlan:
+    """Plan the elastic axis-0 re-deal of ``n`` leading units (each of
+    shape ``row_shape``) from a ``from_size``-way ceil-split to a
+    ``to_size``-way ceil-split, executed on a world of ``exec_size``
+    ranks that embeds both memberships:
+
+    * ``embed_from[i]`` — the executing rank holding source deal
+      position ``i``'s shard (ranks outside the map feed a zeros
+      buffer of the source shard shape);
+    * ``embed_to[j]`` — the executing rank that ends with target deal
+      position ``j``'s shard (ranks outside the map end with zeros).
+
+    A shrink drain runs on the OLD world (``exec_size == from_size``,
+    ``embed_from`` identity, ``embed_to`` = the survivors' old ranks);
+    a grow runs on the NEW world (``embed_to`` identity, ``embed_from``
+    = the survivors' new ranks).  Same strategy set, caching, adjoint
+    (= the reverse resize) and executor contract as
+    :func:`plan_reshard`; ``gather`` is the explicit full-restart
+    baseline and is never auto-picked."""
+    n = int(n)
+    from_size, to_size = int(from_size), int(to_size)
+    exec_size = int(exec_size)
+    if n < 1 or from_size < 1 or to_size < 1:
+        raise CommError(
+            f"plan_resize needs n >= 1 and positive world sizes; got "
+            f"n={n}, {from_size}->{to_size}")
+    embed_from = tuple(int(r) for r in embed_from)
+    embed_to = tuple(int(r) for r in embed_to)
+    if len(embed_from) != from_size or len(embed_to) != to_size:
+        raise CommError(
+            f"embed_from/embed_to must map every deal position: need "
+            f"lengths {from_size}/{to_size}, got "
+            f"{len(embed_from)}/{len(embed_to)}")
+    for name, emb in (("embed_from", embed_from), ("embed_to", embed_to)):
+        if any(not (0 <= r < exec_size) for r in emb):
+            raise CommError(
+                f"{name} names ranks outside the executing world "
+                f"(size {exec_size}): {emb}")
+        if len(set(emb)) != len(emb):
+            raise CommError(
+                f"{name} maps two deal positions onto one executing "
+                f"rank ({emb}) — each rank holds ONE uniform shard "
+                "buffer per side")
+    import numpy as _np
+
+    from ..tune import generation
+
+    return _resize_plan_cached(
+        n, tuple(int(s) for s in row_shape), from_size, to_size,
+        embed_from, embed_to, exec_size, str(_np.dtype(dtype)),
+        _resolve_strategy(strategy), generation())

@@ -37,11 +37,12 @@ from __future__ import annotations
 from ..runtime import (DeadlockError, HealthReport, IntegrityError,
                        RankFailedError)
 from .faults import (FAULT_KINDS, FaultKind, FaultPlan, FaultSpec,
-                     as_plan, fault_scope, register_fault_kind)
+                     as_plan, fault_scope, pending_preemptions,
+                     register_fault_kind)
 from .guards import (IntegrityWarning, check_contributions,
                      clear_violations, last_violation, spmd_finite_value,
                      verify_wire, wire_checksum)
-from .recovery import restore_or_init
+from .recovery import RestoreResult, SkippedStep, restore_or_init
 
 __all__ = [
     "FAULT_KINDS",
@@ -50,6 +51,7 @@ __all__ = [
     "FaultSpec",
     "as_plan",
     "fault_scope",
+    "pending_preemptions",
     "register_fault_kind",
     "IntegrityWarning",
     "check_contributions",
@@ -59,6 +61,8 @@ __all__ = [
     "last_violation",
     "clear_violations",
     "restore_or_init",
+    "RestoreResult",
+    "SkippedStep",
     "DeadlockError",
     "RankFailedError",
     "IntegrityError",

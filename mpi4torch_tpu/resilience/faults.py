@@ -60,7 +60,19 @@ __all__ = [
     "register_fault_kind",
     "fault_scope",
     "as_plan",
+    "pending_preemptions",
 ]
+
+
+def pending_preemptions() -> Dict[int, int]:
+    """The active fault plan's preemption notice board (``{rank:
+    ops_remaining}``), or ``{}`` when no plan is installed — the
+    between-phases poll of the elastic runtime
+    (:meth:`FaultPlan.preemption_notices`)."""
+    from .. import config as _cfg
+
+    plan = _cfg.fault_plan()
+    return plan.preemption_notices() if plan is not None else {}
 
 
 @dataclass(frozen=True)
@@ -120,6 +132,17 @@ register_fault_kind(FaultKind(
         "as IntegrityError naming the rank; float payloads have no "
         "eligible leaf, so the fault is inert off the compressed wire"))
 register_fault_kind(FaultKind(
+    "preempt", frozenset({"exchange", "p2p"}), transient=False,
+    doc="advance-notice teardown (the cloud-preemption shape): on the "
+        "spec's FIRST matching call the rank posts a preemption notice "
+        "(FaultPlan.preemption_notices) but keeps answering collectives "
+        "and probes; on the LAST call of the index..index+count window "
+        "it dies exactly like rank_death (RankFailedError naming the "
+        "rank on every peer).  count-1 ops of advance notice: an elastic "
+        "runtime (mpi4torch_tpu.elastic) that drains the rank inside "
+        "the window resumes on the shrunk world; a job that ignores the "
+        "notice gets the attributed raise"))
+register_fault_kind(FaultKind(
     "truncate_save", frozenset({"checkpoint"}), transient=True,
     doc="the checkpoint write is killed mid-save (the just-written step's "
         "largest file is truncated): resilience.restore_or_init falls "
@@ -171,6 +194,12 @@ class FaultPlan:
         self._counts: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()
         self.fired: List[FiredFault] = []
+        # Preemption notice board (the `preempt` kind): rank -> index of
+        # the matching call it will die on.  Plan-scoped, NOT
+        # world-scoped — notices must outlive the Mode B world of the
+        # phase that posted them (worlds are per-run_ranks; the elastic
+        # driver reads the board between phases).
+        self._preempt_death_at: Dict[int, Tuple[int, int]] = {}
 
     # ------------------------------------------------------------ match
 
@@ -208,6 +237,32 @@ class FaultPlan:
     def fired_kinds(self) -> FrozenSet[str]:
         with self._lock:
             return frozenset(f.kind for f in self.fired)
+
+    def preemption_notices(self) -> Dict[int, int]:
+        """The preemption notice board: ``{rank: ops_remaining}`` for
+        every rank with a posted (and not yet consumed) advance notice —
+        ``ops_remaining`` counts the matching calls the rank will still
+        answer, INCLUDING the one it dies on.  The elastic runtime
+        (mpi4torch_tpu.elastic) polls this between phases and must fit
+        its drain (consensus + replan collectives) inside the budget;
+        a drain that overruns meets the rank's death mid-replan — the
+        same attributed raise an ignored notice gets."""
+        out = {}
+        with self._lock:
+            for rank, (spec_idx, death_at) in \
+                    self._preempt_death_at.items():
+                seen = self._counts.get((spec_idx, rank), 0)
+                remaining = death_at - (seen - 1)
+                if remaining > 0:
+                    out[rank] = remaining
+        return out
+
+    def clear_preemption(self, rank: int) -> None:
+        """Drop ``rank``'s notice — the elastic runtime calls this once
+        the rank has been drained out of the world (its death op will
+        never execute; a stale board entry would re-trigger the drain)."""
+        with self._lock:
+            self._preempt_death_at.pop(rank, None)
 
     def wants_checkpoint(self) -> bool:
         """Cheap pre-check for the checkpoint layer: does any spec
@@ -278,6 +333,27 @@ class FaultPlan:
                 "(simulated preemption)", ranks=(rank,))
             world.mark_dead(rank, err)
             raise err
+        if spec.kind == "preempt":
+            with self._lock:
+                seen = self._counts[(spec_idx, rank)] - 1
+            if seen == spec.index:
+                # The NOTICE: posted on the window's first matching
+                # call; the rank keeps answering until the window ends.
+                # Posting is the firing evidence (the teardown below
+                # may legitimately never run — a drained rank leaves
+                # the world before its death op).
+                with self._lock:
+                    self._preempt_death_at[rank] = (
+                        spec_idx, spec.index + spec.count - 1)
+                self._note(spec, rank, op, site)
+            if seen == spec.index + spec.count - 1:
+                err = RankFailedError(
+                    f"rank {rank} was preempted during {op} after "
+                    f"{spec.count - 1} op(s) of advance notice (the "
+                    "notice went unanswered)", ranks=(rank,))
+                world.mark_dead(rank, err)
+                raise err
+            return payload
         if spec.kind in ("corrupt_nan", "corrupt_inf"):
             value = float("nan") if spec.kind == "corrupt_nan" \
                 else float("inf")

@@ -58,11 +58,19 @@ COVERAGE: Dict[str, Dict[str, str]] = {
                     "compressed": "raise", "overlap": "raise"},
     "bitflip": {"plain": "inert", "fused": "inert",
                 "compressed": "raise", "overlap": "inert"},
+    # With count=1 (the matrix spec default) the advance-notice window
+    # is empty: notice and death land on the same call, so an elastic-
+    # unaware job sees exactly the rank_death shape — the typed,
+    # attributed raise.  The notice-then-drain path (count > 1) is the
+    # elastic matrix's territory (mpi4torch_tpu.elastic.matrix).
+    "preempt": {"plain": "raise", "fused": "raise",
+                "compressed": "raise", "overlap": "raise"},
     "truncate_save": {"checkpoint": "recover"},
 }
 
 EXPECTED_ERROR = {
     "rank_death": RankFailedError,
+    "preempt": RankFailedError,
     "corrupt_nan": IntegrityError,
     "corrupt_inf": IntegrityError,
     "bitflip": IntegrityError,

@@ -11,10 +11,14 @@ its checkpoints' worth of work to one bad write.
 :func:`restore_or_init` is the survivable resume verb: walk the step
 history newest-first, restore the first step that actually loads, skip
 garbage (truncated data, a stray non-numeric directory, a step dir a
-concurrent cleaner half-removed) with a warning instead of a crash,
-and fall back to the initial state only when nothing usable remains::
+concurrent cleaner half-removed) — each skip recorded in the result's
+``skipped`` ledger (step + why) as well as warned — and fall back to
+the initial state only when nothing usable remains::
 
-    state, step = mpi.resilience.restore_or_init(workdir, template=state)
+    res = mpi.resilience.restore_or_init(workdir, template=state)
+    state, step = res                      # tuple-compatible
+    for s in res.skipped:                  # the torn-step ledger
+        log.warning("skipped step %d: %s", s.step, s.reason)
     for step in range(0 if step is None else step + 1, n_steps):
         state = train_step(state)
         mgr.save(step, state)
@@ -24,11 +28,43 @@ from __future__ import annotations
 
 import os
 import warnings
-from typing import Any, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 from ..runtime import CommError
 
-__all__ = ["restore_or_init"]
+__all__ = ["restore_or_init", "RestoreResult", "SkippedStep"]
+
+
+class SkippedStep(NamedTuple):
+    """One step directory :func:`restore_or_init` walked past: the step
+    number and the reason it was unusable (the exception class + message
+    of the failed restore attempt)."""
+    step: int
+    reason: str
+
+
+class RestoreResult(tuple):
+    """The :func:`restore_or_init` result: unpacks as the historical
+    ``(state, step)`` pair AND carries the torn-step ledger as
+    ``.skipped`` (what was walked past and why — previously
+    warning-only, invisible to the resuming program)."""
+
+    def __new__(cls, state, step, skipped=()):
+        self = super().__new__(cls, (state, step))
+        self._skipped = tuple(skipped)
+        return self
+
+    @property
+    def state(self):
+        return self[0]
+
+    @property
+    def step(self) -> Optional[int]:
+        return self[1]
+
+    @property
+    def skipped(self) -> Tuple[SkippedStep, ...]:
+        return self._skipped
 
 
 def _scan_steps(directory: str):
@@ -48,23 +84,33 @@ def _scan_steps(directory: str):
 
 def restore_or_init(directory: str, template: Any, *,
                     init: Any = None,
-                    max_to_keep: Optional[int] = None
-                    ) -> Tuple[Any, Optional[int]]:
+                    max_to_keep: Optional[int] = None,
+                    expect_epoch: Optional[int] = None
+                    ) -> RestoreResult:
     """Restore the newest *loadable* checkpoint under ``directory`` into
     ``template``'s structure, falling back step by step past corrupt or
-    partial saves; returns ``(state, step)``.
+    partial saves; returns a :class:`RestoreResult` — unpackable as
+    ``(state, step)``, with the skipped-step ledger on ``.skipped``.
 
     ``step`` is the restored step number, or ``None`` when no usable
     checkpoint exists — then ``state`` is ``init`` (or ``template``
     itself when ``init`` is not given), i.e. a fresh start.  Unusable
-    steps (truncated mid-save, garbage directories) are *skipped with a
-    warning*, never fatal: surviving a torn write is the whole point
-    (ISSUE 7 tentpole, preemption-safe recovery)."""
+    steps (truncated mid-save, garbage directories) are *skipped*, never
+    fatal — surviving a torn write is the whole point (ISSUE 7
+    tentpole) — and every skip is surfaced in ``.skipped`` with its
+    reason, so the resuming program can alert on storage rot instead of
+    silently losing steps.
+
+    ``expect_epoch`` fences stale-world resumes: a step saved under a
+    different elastic world epoch raises the typed ``CommError`` naming
+    both epochs (the :mod:`mpi4torch_tpu.elastic` discipline) instead
+    of being walked past — resuming a resized world from a pre-resize
+    step needs an explicit re-lay, not a silent fallback."""
     from ..utils.checkpoint import CheckpointManager
 
     state_init = template if init is None else init
     if not os.path.isdir(directory):
-        return state_init, None
+        return RestoreResult(state_init, None)
     try:
         with CheckpointManager(directory, max_to_keep=max_to_keep) as mgr:
             steps = sorted(mgr.all_steps(), reverse=True)
@@ -74,6 +120,7 @@ def restore_or_init(directory: str, template: Any, *,
             "falling back to a directory scan",
             RuntimeWarning, stacklevel=2)
         steps = _scan_steps(directory)
+    skipped = []
     for step in steps:
         # A FRESH manager per attempt: orbax latches item layouts it
         # inspected — a failed restore of a garbage step would poison
@@ -82,18 +129,23 @@ def restore_or_init(directory: str, template: Any, *,
         try:
             with CheckpointManager(directory,
                                    max_to_keep=max_to_keep) as mgr:
-                state = mgr.restore(step, template=template)
+                state = mgr.restore(step, template=template,
+                                    expect_epoch=expect_epoch)
         except CommError:
             # A saved-vs-template layout mismatch (utils.checkpoint's
-            # upfront guard) holds for EVERY step — walking back would
-            # silently discard the whole history and restart from init.
-            # Propagate the typed error pointing at restore_resharded.
+            # upfront guard) or a stale-world epoch mismatch holds for
+            # EVERY step saved under that layout/epoch — walking back
+            # would silently discard the whole history and restart from
+            # init.  Propagate the typed error (it points at the
+            # migration/replan recipe).
             raise
         except Exception as e:  # noqa: BLE001 — torn step: fall back
+            reason = f"{type(e).__name__}: {str(e)[:200]}"
+            skipped.append(SkippedStep(step, reason))
             warnings.warn(
                 f"checkpoint step {step} is unusable "
                 f"({type(e).__name__}); falling back to the previous "
                 "complete step", RuntimeWarning, stacklevel=2)
             continue
-        return state, step
-    return state_init, None
+        return RestoreResult(state, step, skipped)
+    return RestoreResult(state_init, None, skipped)

@@ -133,11 +133,16 @@ class HealthReport:
     """Result of :meth:`World.health_check` / ``comm.check_health()`` —
     a timeout-bounded *attributed* barrier probe: ``ok`` says whether
     every rank answered within the bound, ``arrived``/``missing`` name
-    who did and who did not (mpi4torch_tpu.resilience)."""
+    who did and who did not (mpi4torch_tpu.resilience).
+    ``probe_duration_s`` is this caller's wall time inside the probe —
+    a failed probe burns its timeout, a healthy one returns in
+    microseconds, and the elastic consensus (mpi4torch_tpu.elastic)
+    budgets its rounds off exactly that difference."""
     ok: bool
     size: int
     arrived: FrozenSet[int]
     missing: FrozenSet[int]
+    probe_duration_s: float = 0.0
 
     def __bool__(self) -> bool:
         return self.ok
@@ -535,16 +540,31 @@ class World:
         fabricated as healthy."""
         timeout = self.timeout if timeout is None else float(timeout)
         everyone = frozenset(range(self.size))
+        t0 = time.monotonic()
         try:
             self._health.wait(rank, timeout, retries=0, backoff=0.0)
         except _BarrierTimeout as t:
-            return HealthReport(False, self.size, t.arrived,
-                                everyone - t.arrived)
+            return self._health_report(False, t.arrived, everyone, t0)
         except _BarrierBroken as b:
             arrived = frozenset() if b.arrived is None else b.arrived
-            return HealthReport(False, self.size, arrived,
-                                everyone - arrived)
-        return HealthReport(True, self.size, everyone, frozenset())
+            return self._health_report(False, arrived, everyone, t0)
+        return self._health_report(True, everyone, everyone, t0)
+
+    def _health_report(self, ok: bool, arrived: FrozenSet[int],
+                       everyone: FrozenSet[int], t0: float) -> HealthReport:
+        """Assemble a probe report and count it in the obs metrics
+        registry (``comm_health_probes_total`` with an ok/failed result
+        label) — probes are exceptional-path by construction, so the
+        registry write is off the comm hot path like every other obs
+        metric."""
+        dur = time.monotonic() - t0
+        from .obs import metrics as _metrics
+        _metrics.inc(
+            f'comm_health_probes_total{{result="{"ok" if ok else "failed"}"}}',
+            help="health_check barrier probes by outcome")
+        return HealthReport(ok, self.size, frozenset(arrived),
+                            everyone - frozenset(arrived),
+                            probe_duration_s=dur)
 
     # ------------------------------------------------------------------ p2p
 

@@ -398,20 +398,29 @@ class Engine:
         self.stats.mark(req.rid, "finished")
         self.stats.count("finished")
 
-    def _evict(self, j: int) -> None:
-        req = self._slot_req[j]
-        self._slot_req[j] = None
-        self._tokens[j] = 0
-        self._pos[j] = 0
-        # Re-poison the freed slot's cache rows: stale K/V must be
-        # provably inert, not accidentally plausible.
+    def _release_slots(self, idxs: List[int]) -> None:
+        """Return slots to the free pool and re-poison their cache
+        rows in ONE pass: stale K/V must be provably inert, not
+        accidentally plausible.  Shared by eviction and the elastic
+        drain so the poisoning convention has a single home."""
+        if not idxs:
+            return
+        for j in idxs:
+            self._slot_req[j] = None
+            self._tokens[j] = 0
+            self._pos[j] = 0
         if jnp.issubdtype(jnp.dtype(self._dtype), jnp.floating):
+            arr = jnp.asarray(idxs)
             if self._spmd:
                 self._cache = jax.tree.map(
-                    lambda s: s.at[:, j].set(jnp.nan), self._cache)
+                    lambda s: s.at[:, arr].set(jnp.nan), self._cache)
             else:
                 self._cache = jax.tree.map(
-                    lambda s: s.at[j].set(jnp.nan), self._cache)
+                    lambda s: s.at[arr].set(jnp.nan), self._cache)
+
+    def _evict(self, j: int) -> None:
+        req = self._slot_req[j]
+        self._release_slots([j])
         self.stats.count("evicted")
         self._finish(req)
 
@@ -484,6 +493,48 @@ class Engine:
         out, self._results = self._results, {}
         self._known_rids.difference_update(out)
         return out
+
+    # ------------------------------------------------------------ elastic
+
+    def _inflight_records(self) -> List[dict]:
+        """Host-side snapshot of every unfinished request (queued and
+        slotted), in slot order then queue order — the drain payload of
+        the elastic runtime (mpi4torch_tpu.elastic.replan)."""
+        recs = []
+        for req in self._slot_req:
+            if req is not None:
+                recs.append(req)
+        recs.extend(self._queue)
+        return [{"rid": r.rid,
+                 "prompt": np.array(r.prompt, copy=True),
+                 "emitted": list(r.emitted),
+                 "max_new": r.max_new,
+                 "key": r.key} for r in recs]
+
+    def snapshot_inflight(self) -> List[dict]:
+        """Non-destructive :meth:`drain`: the same records, with the
+        engine untouched.  An elastic driver snapshots after each step
+        so that a rank death mid-step still leaves a survivor-held
+        ledger to re-admit from (host request state is identical on
+        every rank — tokens are selected host-side, deterministically)."""
+        return self._inflight_records()
+
+    def drain(self) -> List[dict]:
+        """Drain every unfinished request out of the engine: returns
+        their records (prompt, tokens emitted so far, remaining budget,
+        the advanced sampling key) and releases their slots (cache rows
+        re-poisoned) and queue entries.  Finished results stay
+        retrievable via :meth:`results`.  The elastic shrink/grow path:
+        drain here, re-admit on the new world's engine through the
+        ordinary admission POLICIES (``elastic.replan.readmit``)."""
+        recs = self._inflight_records()
+        self._release_slots([j for j, req in enumerate(self._slot_req)
+                             if req is not None])
+        self._queue.clear()
+        # The drained rids leave this engine's ledger: they will be
+        # re-admitted on ANOTHER engine (or back here) explicitly.
+        self._known_rids.difference_update(r["rid"] for r in recs)
+        return recs
 
     # ------------------------------------------------------------- census
 

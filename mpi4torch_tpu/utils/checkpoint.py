@@ -31,7 +31,7 @@ import os
 from typing import Any, Optional
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "restore_resharded",
-           "CheckpointManager"]
+           "saved_epoch", "CheckpointManager"]
 
 
 def _saved_shapes(path: str):
@@ -106,6 +106,62 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+# Sidecar file recording the elastic world epoch a step was saved under
+# (mpi4torch_tpu.elastic): written AFTER orbax finalizes the step, so a
+# step with a sidecar is by construction a completed save.
+_EPOCH_FILE = "WORLD_EPOCH"
+
+
+def _write_epoch(path: str, epoch: Optional[int]) -> None:
+    if epoch is None:
+        return
+    try:
+        with open(os.path.join(path, _EPOCH_FILE), "w",
+                  encoding="utf-8") as f:
+            f.write(str(int(epoch)))
+    except OSError:
+        # Epoch stamping is advisory metadata; a stamp that cannot be
+        # written must not fail the (already finalized) save.
+        pass
+
+
+def saved_epoch(path: str) -> Optional[int]:
+    """The world epoch recorded with the checkpoint at ``path`` (or the
+    ``<path>/default`` item dir), ``None`` when the step predates epoch
+    stamping or was saved without one."""
+    for p in (path, os.path.dirname(path)):
+        try:
+            with open(os.path.join(p, _EPOCH_FILE),
+                      encoding="utf-8") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def _check_epoch_match(path: str, expect_epoch: Optional[int]) -> None:
+    """Stale-world fencing: a checkpoint saved under world epoch ``e``
+    must not silently resume a world at epoch ``e' != e`` — the mesh
+    (and with it every shard's meaning) may have changed in between.
+    Elastic recovery that KNOWS the epoch moved restores with the saved
+    epoch (or ``expect_epoch=None``) and re-lays the state explicitly
+    (mpi4torch_tpu.elastic.replan / utils.checkpoint.restore_resharded)."""
+    if expect_epoch is None:
+        return
+    from ..runtime import CommError
+
+    saved = saved_epoch(path)
+    if saved is not None and saved != int(expect_epoch):
+        raise CommError(
+            f"checkpoint at {path} was saved under world epoch {saved}, "
+            f"but this resume expects epoch {int(expect_epoch)} — the "
+            "world was resized in between (stale-world resume).  "
+            "Restore deliberately (expect_epoch=None or the saved "
+            "epoch) and re-lay the state onto the current world with "
+            "the elastic replan recipes (doc/elasticity.md) instead of "
+            "resuming blind.")
+
+
 def _post_save_fault(path: str) -> None:
     """Deterministic fault-injection hook (mpi4torch_tpu.resilience):
     when the active fault plan targets checkpoint saves
@@ -123,12 +179,16 @@ def _post_save_fault(path: str) -> None:
     plan.on_checkpoint_save(path, rank=effective_rank_context().rank)
 
 
-def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
+def save_checkpoint(path: str, state: Any, *, force: bool = False,
+                    epoch: Optional[int] = None) -> None:
     """Write pytree ``state`` to directory ``path`` (created; absolute
     paths required by orbax — relative inputs are resolved here).
 
     Atomic: a partially-written checkpoint is never visible at ``path``.
-    ``force`` overwrites an existing complete checkpoint."""
+    ``force`` overwrites an existing complete checkpoint.  ``epoch``
+    stamps the elastic world epoch the state was saved under (see
+    :func:`saved_epoch`; restores passing ``expect_epoch`` raise on a
+    stale-world mismatch)."""
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     try:
@@ -136,22 +196,27 @@ def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
         ckptr.wait_until_finished()
     finally:
         ckptr.close()
+    _write_epoch(path, epoch)
     _post_save_fault(path)
 
 
-def restore_checkpoint(path: str, template: Any) -> Any:
+def restore_checkpoint(path: str, template: Any, *,
+                       expect_epoch: Optional[int] = None) -> Any:
     """Read the pytree at ``path`` into ``template``'s structure.
 
     ``template`` supplies treedef, dtypes and (critically) shardings:
     leaves restore directly to the template leaf's placement, so a state
     sharded over a mesh round-trips without host gathering.  Raises
-    ``FileNotFoundError`` when ``path`` holds no complete checkpoint."""
+    ``FileNotFoundError`` when ``path`` holds no complete checkpoint,
+    and a typed ``CommError`` naming both epochs when ``expect_epoch``
+    disagrees with the recorded world epoch (stale-world fencing)."""
     import jax
     import orbax.checkpoint as ocp  # noqa: F401 — orbax must be importable
 
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint directory at {path}")
+    _check_epoch_match(path, expect_epoch)
     _check_layout_match(path, template)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
     ckptr = _checkpointer()
@@ -240,9 +305,13 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, *, force: bool = False,
+             epoch: Optional[int] = None) -> bool:
         """Save ``state`` as checkpoint ``step``; returns whether a save
-        happened (the manager skips off-interval steps unless forced)."""
+        happened (the manager skips off-interval steps unless forced).
+        ``epoch`` stamps the elastic world epoch per step (read back by
+        :func:`saved_epoch`; ``restore(expect_epoch=...)`` fences
+        stale-world resumes)."""
         import orbax.checkpoint as ocp
 
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
@@ -251,11 +320,15 @@ class CheckpointManager:
             from .. import config as _cfg
 
             plan = _cfg.fault_plan()
-            if plan is not None and plan.wants_checkpoint():
-                # Only under an active checkpoint-targeting fault plan:
-                # finalize synchronously so the step's files exist
-                # before the injected mid-save kill damages them.
+            needs_sync = epoch is not None or (
+                plan is not None and plan.wants_checkpoint())
+            if needs_sync:
+                # Finalize synchronously so the step directory exists
+                # before the epoch sidecar lands in it (and before an
+                # injected mid-save kill damages the files).
                 self._mgr.wait_until_finished()
+                _write_epoch(self._step_path(step), epoch)
+            if plan is not None and plan.wants_checkpoint():
                 _post_save_fault(self._step_path(step))
         return bool(saved)
 
@@ -278,14 +351,17 @@ class CheckpointManager:
                 return full
         return p
 
-    def restore(self, step: int, template: Any) -> Any:
+    def restore(self, step: int, template: Any, *,
+                expect_epoch: Optional[int] = None) -> Any:
         import jax
         import orbax.checkpoint as ocp
 
         # Same upfront layout guard as restore_checkpoint: without it a
         # mesh-mismatched RESUME surfaces as an opaque orbax error that
         # restore_or_init would misread as a torn step and walk back
-        # through the entire history.
+        # through the entire history.  The epoch fence runs first: a
+        # stale-world resume is a coordination error, not a torn step.
+        _check_epoch_match(self._step_path(step), expect_epoch)
         _check_layout_match(self._step_path(step), template)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         return self._mgr.restore(

@@ -1,0 +1,791 @@
+"""mpi4torch_tpu.elastic — live world resize (ISSUE 13).
+
+Coverage per the acceptance criteria:
+
+* membership consensus: agreement (leaving/joining), post-death probe
+  consensus on a world with absent ranks, injected disagreement →
+  typed rank-attributed ``ConsensusError``, a second failure
+  mid-consensus → attributed ``RankFailedError`` — never a hang;
+* epoch fencing at every layer: consensus tags, the driver's
+  ``StaleEpochError`` (naming both epochs), and the checkpoint stamp
+  (``expect_epoch`` raises a typed ``CommError`` naming both epochs;
+  ``restore_or_init`` surfaces skipped torn steps in its return
+  value);
+* ``reshard.plan_resize``: cross-world-size axis-0 re-deals bitwise vs
+  the numpy oracle (shrink, grow, padded flat, TP rows), the gather
+  baseline strictly more expensive, adjoint = the grow-back, VJP
+  intact;
+* the ``preempt`` fault kind: notice board semantics, death at the
+  window end, and its resilience-matrix row;
+* hot-spare mirrors: the spare's full replica bitwise vs the owners',
+  zero-reshard takeover;
+* serve drain/re-admission: in-flight requests survive a resize with
+  token streams bitwise vs per-request ``generate()``;
+* the grow-after-shrink round-trip: (8,)→(6,)→(8,) ZeRO training state
+  bitwise vs the NEVER-FAILED oracle (sample-dealt SUM gradients make
+  the global math world-size-independent and dyadic-exact);
+* the censused elastic matrix: fast representative cells in tier-1,
+  the full (kind × subsystem × action) sweep on the ``slow`` lane, and
+  the registry-sync guard.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import elastic as E
+from mpi4torch_tpu import reshard as rs
+from mpi4torch_tpu.elastic import matrix as ematrix
+from mpi4torch_tpu.runtime import CommError, RankFailedError
+
+
+# --------------------------------------------------------------------------
+# registry sync
+# --------------------------------------------------------------------------
+
+
+class TestRegistrySync:
+    def test_elastic_registry_in_sync(self):
+        from mpi4torch_tpu.analyze.registry import elastic_problems
+
+        assert elastic_problems() == []
+
+    def test_missing_resilience_row_detected(self, monkeypatch):
+        from mpi4torch_tpu.analyze.registry import elastic_problems
+        from mpi4torch_tpu.resilience import matrix as rmatrix
+
+        cov = {k: v for k, v in rmatrix.COVERAGE.items()
+               if k != "preempt"}
+        monkeypatch.setattr(rmatrix, "COVERAGE", cov)
+        assert any("preempt" in p for p in elastic_problems())
+
+    def test_coverage_drift_detected(self, monkeypatch):
+        from mpi4torch_tpu.analyze.registry import elastic_problems
+
+        cov = dict(ematrix.COVERAGE)
+        cov.pop(("preempt", "zero", "shrink"))
+        monkeypatch.setattr(ematrix, "COVERAGE", cov)
+        assert any("drift" in p for p in elastic_problems())
+
+    def test_preempt_registered_and_covered(self):
+        from mpi4torch_tpu.resilience import FAULT_KINDS
+        from mpi4torch_tpu.resilience.matrix import (COVERAGE,
+                                                     EXPECTED_ERROR)
+
+        assert "preempt" in FAULT_KINDS
+        assert not FAULT_KINDS["preempt"].transient
+        assert set(COVERAGE["preempt"]) == {"plain", "fused",
+                                            "compressed", "overlap"}
+        assert EXPECTED_ERROR["preempt"] is RankFailedError
+
+
+# --------------------------------------------------------------------------
+# WorldView / epoch fencing
+# --------------------------------------------------------------------------
+
+
+class TestWorldView:
+    def test_initial_and_mapping(self):
+        v = E.initial_view(4)
+        assert v.epoch == 0 and v.size == 4
+        assert v.alive == (0, 1, 2, 3) and v.mesh_shape == (4,)
+        assert v.position(2) == 2 and v.id_at(3) == 3
+        v2 = E.WorldView(3, (0, 2, 5), (3,))
+        assert v2.position(5) == 2
+        with pytest.raises(E.ElasticError):
+            v2.position(1)
+
+    def test_validation(self):
+        with pytest.raises(E.ElasticError):
+            E.WorldView(-1, (0,), (1,))
+        with pytest.raises(E.ElasticError):
+            E.WorldView(0, (1, 0), (2,))          # unsorted
+        with pytest.raises(E.ElasticError):
+            E.WorldView(0, (0, 0), (2,))          # duplicate
+        with pytest.raises(E.ElasticError):
+            E.WorldView(0, (0, 1, 2), (2, 2))     # mesh != members
+
+    def test_fence_tags_disjoint(self):
+        tags = {E.fence_tag(e, p) for e in range(5) for p in range(4)}
+        assert len(tags) == 20
+
+    def test_stale_epoch_fenced_by_driver(self):
+        rt = E.ElasticRuntime(2, world_timeout=5.0)
+        stale = rt.view
+        # Adopt epoch 1 (everyone alive, no change besides the epoch).
+        rt.consensus()
+        assert rt.epoch == 1
+        with pytest.raises(E.StaleEpochError) as ei:
+            rt.run_phase(lambda pos, rid: None, view=stale)
+        assert ei.value.have == 0 and ei.value.want == 1
+
+
+# --------------------------------------------------------------------------
+# consensus
+# --------------------------------------------------------------------------
+
+
+class TestConsensus:
+    def test_agreement_with_leaving_and_joining(self):
+        view = E.initial_view(4)
+
+        def body(rank):
+            return E.agree_world_view(view, leaving=[1], joining=[7],
+                                      probe_timeout=2.0)
+
+        outs = mpi.run_ranks(body, 4, timeout=10.0)
+        assert len(set(outs)) == 1
+        got = outs[0]
+        assert got.epoch == 1 and got.alive == (0, 2, 3, 7)
+
+    def test_post_death_probe_consensus_excludes_missing(self):
+        rt = E.ElasticRuntime(4, probe_timeout=0.5, world_timeout=8.0)
+        rt.note_dead(2, "reported by the driver")
+        got = rt.consensus()
+        assert got.alive == (0, 1, 3) and got.epoch == 1
+        assert rt.view is got
+
+    def test_disagreement_raises_attributed(self):
+        rec = ematrix.run_consensus_cell("disagree")
+        assert rec["status"] == "ok", rec["detail"]
+
+    def test_second_failure_raises_attributed(self):
+        rec = ematrix.run_consensus_cell("second_failure")
+        assert rec["status"] == "ok", rec["detail"]
+        assert "rank_death" in rec["fired"]
+
+    def test_transition_metrics(self):
+        from mpi4torch_tpu.obs import metrics as om
+
+        om.reset_metrics()
+        rt = E.ElasticRuntime(3, world_timeout=8.0)
+        rt.consensus()
+        snap = om.snapshot()
+        assert snap["counters"]["elastic_epoch_transitions_total"] == 1
+        assert snap["gauges"]["elastic_world_epoch"] == 1
+        assert snap["gauges"]["elastic_world_size"] == 3
+
+    def test_consensus_failure_gets_flight_postmortem(self):
+        """A failed resize is postmortem-worthy: ConsensusError rides
+        the SAME reaper entry every attributed failure does (zero new
+        hooks), so the flight recorder snapshots the wire tails and
+        names the disagreeing id."""
+        from mpi4torch_tpu import obs
+
+        view = E.initial_view(3)
+
+        def body(rank):
+            def propose(p):
+                if rank == 1:
+                    return E.WorldView(p.epoch, p.alive, (1, 3))
+                return p
+            return E.agree_world_view(view, probe_timeout=0.5,
+                                      _propose=propose)
+
+        with obs.trace() as tr:
+            with pytest.raises(E.ConsensusError):
+                mpi.run_ranks(body, 3, timeout=8.0)
+            pm = tr.last_postmortem()
+        assert pm is not None
+        assert pm["error"] == "ConsensusError"
+        assert pm["failed_ranks"] == [1]
+
+    def test_leaving_unknown_id_raises(self):
+        view = E.initial_view(2)
+
+        def body(rank):
+            return E.agree_world_view(view, leaving=[5],
+                                      probe_timeout=1.0)
+
+        with pytest.raises(E.ElasticError):
+            mpi.run_ranks(body, 2, timeout=5.0)
+
+
+class TestHealthProbeMetrics:
+    def test_probe_duration_and_counters(self):
+        from mpi4torch_tpu.obs import metrics as om
+
+        om.reset_metrics()
+
+        def body(rank):
+            return mpi.COMM_WORLD.check_health(2.0)
+
+        reps = mpi.run_ranks(body, 3, timeout=8.0)
+        assert all(r.ok for r in reps)
+        assert all(r.probe_duration_s >= 0.0 for r in reps)
+        counters = om.snapshot()["counters"]
+        assert counters['comm_health_probes_total{result="ok"}'] == 3
+        text = om.prometheus_text()
+        # The labeled sample keeps its label set; the TYPE header uses
+        # the bare family name exactly once.
+        assert ('mpi4torch_comm_health_probes_total{result="ok"} 3'
+                in text)
+        assert text.count(
+            "# TYPE mpi4torch_comm_health_probes_total counter") == 1
+
+    def test_failed_probe_counter(self):
+        from mpi4torch_tpu.obs import metrics as om
+
+        om.reset_metrics()
+
+        def body(rank):
+            if rank == 1:
+                return None        # never probes: the others time out
+            return mpi.COMM_WORLD.check_health(0.3)
+
+        reps = mpi.run_ranks(body, 3, timeout=8.0)
+        failed = [r for r in reps if r is not None]
+        assert all(not r.ok and 1 in r.missing for r in failed)
+        counters = om.snapshot()["counters"]
+        assert counters['comm_health_probes_total{result="failed"}'] == 2
+
+
+# --------------------------------------------------------------------------
+# preempt fault kind
+# --------------------------------------------------------------------------
+
+
+class TestPreemptKind:
+    def test_notice_then_survival_inside_window(self):
+        from mpi4torch_tpu.resilience import (FaultSpec, fault_scope,
+                                              pending_preemptions)
+
+        def body(rank):
+            x = jnp.arange(8, dtype=jnp.float32)
+            for _ in range(3):
+                mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM)
+            return pending_preemptions()
+
+        spec = FaultSpec("preempt", rank=1, op="Allreduce", index=0,
+                         count=10)
+        with fault_scope([spec]) as plan:
+            outs = mpi.run_ranks(body, 3, timeout=8.0)
+        assert "preempt" in plan.fired_kinds()
+        # Inside the body after 3 ops: death at op index 9, so 7 remain.
+        assert outs[0] == {1: 7}
+        # Board persists past the world: the driver polls between
+        # phases.
+        assert plan.preemption_notices() == {1: 7}
+        plan.clear_preemption(1)
+        assert plan.preemption_notices() == {}
+
+    def test_death_at_window_end_attributed(self):
+        from mpi4torch_tpu.resilience import FaultSpec, fault_scope
+
+        def body(rank):
+            x = jnp.arange(4, dtype=jnp.float32)
+            for _ in range(4):
+                mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM)
+
+        spec = FaultSpec("preempt", rank=1, op="Allreduce", index=0,
+                         count=3)
+        with fault_scope([spec]) as plan:
+            with pytest.raises(RankFailedError) as ei:
+                mpi.run_ranks(body, 3, timeout=2.0)
+        assert ei.value.ranks == frozenset({1})
+        assert "advance notice" in str(ei.value)
+        assert "preempt" in plan.fired_kinds()
+
+    def test_resilience_matrix_row(self):
+        from mpi4torch_tpu.resilience import matrix as rmatrix
+
+        rec = rmatrix.run_cell("preempt", "plain", nranks=3)
+        assert rec["status"] == "ok", rec["detail"]
+
+
+# --------------------------------------------------------------------------
+# plan_resize
+# --------------------------------------------------------------------------
+
+
+def _exec_resize(plan, inputs, exec_size, differentiable=False):
+    def body(rank):
+        return np.asarray(rs.apply_plan(
+            mpi.COMM_WORLD, plan, jnp.asarray(inputs[rank]),
+            differentiable=differentiable))
+
+    return mpi.run_ranks(body, exec_size, timeout=20.0)
+
+
+class TestPlanResize:
+    def _flat_case(self, n, W, M, strategy=None):
+        perW, perM = -(-n // W), -(-n // M)
+        data = np.arange(n, dtype=np.float64)
+        src = np.pad(data, (0, perW * W - n))
+        want = np.pad(data, (0, perM * M - n))
+        plan = rs.plan_resize(n, (), W, M, np.float64,
+                              embed_from=tuple(range(W)),
+                              embed_to=tuple(range(M)),
+                              exec_size=max(W, M), strategy=strategy)
+        inputs = [src[r * perW:(r + 1) * perW] if r < W
+                  else np.zeros(perW) for r in range(max(W, M))]
+        outs = _exec_resize(plan, inputs, max(W, M))
+        for j in range(M):
+            np.testing.assert_array_equal(
+                outs[j], want[j * perM:(j + 1) * perM])
+        return plan
+
+    def test_shrink_padded_flat_bitwise(self):
+        self._flat_case(100, 8, 6)
+
+    def test_grow_padded_flat_bitwise(self):
+        self._flat_case(100, 6, 8)
+
+    def test_gather_strategy_bitwise_and_costlier(self):
+        p = self._flat_case(96, 8, 6)
+        g = self._flat_case(96, 8, 6, strategy="gather")
+        assert g.strategy == "gather"
+        assert p.wire_bytes < g.wire_bytes
+        assert p.peak_bytes < g.peak_bytes
+
+    def test_rows_resize_bitwise(self):
+        bank = np.arange(24 * 4, dtype=np.float32).reshape(24, 4)
+        plan = rs.plan_resize(24, (4,), 8, 6, np.float32,
+                              embed_from=tuple(range(8)),
+                              embed_to=tuple(range(6)), exec_size=8)
+        inputs = [bank[r * 3:(r + 1) * 3] for r in range(8)]
+        outs = _exec_resize(plan, inputs, 8)
+        for j in range(6):
+            np.testing.assert_array_equal(outs[j],
+                                          bank[j * 4:(j + 1) * 4])
+
+    def test_adjoint_is_grow_back(self):
+        plan = rs.plan_resize(24, (), 8, 6, np.float32,
+                              embed_from=tuple(range(8)),
+                              embed_to=tuple(range(6)), exec_size=8)
+        adj = plan.adjoint()
+        assert adj.in_shape == plan.out_shape
+        assert adj.out_shape == plan.in_shape
+        data = np.arange(24, dtype=np.float32)
+
+        def body(rank):
+            comm = mpi.COMM_WORLD
+            x = jnp.asarray(data[rank * 3:(rank + 1) * 3])
+            y = rs.apply_plan(comm, plan, x, differentiable=False)
+            back = rs.apply_plan(comm, adj, y, differentiable=False)
+            return np.asarray(back)
+
+        outs = mpi.run_ranks(body, 8, timeout=20.0)
+        for r in range(8):
+            np.testing.assert_array_equal(outs[r],
+                                          data[r * 3:(r + 1) * 3])
+
+    def test_vjp_round_trips_cotangents(self):
+        plan = rs.plan_resize(24, (), 8, 6, np.float32,
+                              embed_from=tuple(range(8)),
+                              embed_to=tuple(range(6)), exec_size=8)
+        data = np.arange(24, dtype=np.float32)
+
+        def body(rank):
+            x = jnp.asarray(data[rank * 3:(rank + 1) * 3])
+
+            def f(v):
+                y = rs.apply_plan(mpi.COMM_WORLD, plan, v)
+                return jnp.sum(y * 3.0)
+
+            return np.asarray(jax.grad(f)(x))
+
+        grads = mpi.run_ranks(body, 8, timeout=20.0)
+        for r in range(8):
+            np.testing.assert_array_equal(grads[r],
+                                          np.full(3, 3.0, np.float32))
+
+    def test_validation(self):
+        with pytest.raises(CommError):
+            rs.plan_resize(24, (), 8, 6, np.float32,
+                           embed_from=(0,), embed_to=tuple(range(6)),
+                           exec_size=8)
+        with pytest.raises(CommError):
+            rs.plan_resize(24, (), 8, 6, np.float32,
+                           embed_from=tuple(range(8)),
+                           embed_to=(0, 0, 1, 2, 3, 4), exec_size=8)
+        with pytest.raises(CommError):
+            rs.plan_resize(24, (), 8, 6, np.float32,
+                           embed_from=tuple(range(8)),
+                           embed_to=(0, 1, 2, 3, 4, 9), exec_size=8)
+
+    def test_plan_reshard_still_refuses_size_change(self):
+        with pytest.raises(CommError, match="world size"):
+            rs.plan_reshard(rs.layout((8,), 0), rs.layout((6,), 0),
+                            (24,), np.float32)
+
+
+# --------------------------------------------------------------------------
+# checkpoint epoch + skipped ledger
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _orbax():
+    pytest.importorskip("orbax.checkpoint")
+
+
+class TestCheckpointEpoch:
+    def _state(self, s):
+        return {"w": jnp.arange(6, dtype=jnp.float32) * (s + 1)}
+
+    def test_epoch_stamp_and_stale_fence(self, tmp_path, _orbax):
+        from mpi4torch_tpu.utils.checkpoint import (CheckpointManager,
+                                                    saved_epoch)
+
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as mgr:
+            mgr.save(0, self._state(0), force=True, epoch=2)
+            mgr.wait_until_finished()
+            assert saved_epoch(mgr._step_path(0)) == 2
+            with pytest.raises(CommError) as ei:
+                mgr.restore(0, template=self._state(0), expect_epoch=5)
+            assert "epoch 2" in str(ei.value)
+            assert "epoch 5" in str(ei.value)
+            got = mgr.restore(0, template=self._state(0), expect_epoch=2)
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(self._state(0)["w"]))
+
+    def test_unstamped_step_passes_any_expectation(self, tmp_path,
+                                                   _orbax):
+        from mpi4torch_tpu.utils.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as mgr:
+            mgr.save(0, self._state(0), force=True)
+            mgr.wait_until_finished()
+            mgr.restore(0, template=self._state(0), expect_epoch=7)
+
+    def test_restore_or_init_surfaces_skipped_steps(self, tmp_path,
+                                                    _orbax):
+        import warnings
+
+        from mpi4torch_tpu.resilience import (FaultSpec, fault_scope,
+                                              restore_or_init)
+        from mpi4torch_tpu.utils.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        spec = FaultSpec("truncate_save", rank=0, op="ckpt_save",
+                         index=2)
+        with fault_scope([spec]):
+            with CheckpointManager(d) as mgr:
+                for s in range(3):
+                    mgr.save(s, self._state(s), force=True, epoch=0)
+                mgr.wait_until_finished()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            res = restore_or_init(d, template=self._state(0),
+                                  expect_epoch=0)
+        state, step = res              # tuple compatibility intact
+        assert step == 1 and res.step == 1 and res.state is state
+        assert [s.step for s in res.skipped] == [2]
+        assert res.skipped[0].reason    # the why, not just the what
+        np.testing.assert_array_equal(np.asarray(state["w"]),
+                                      np.asarray(self._state(1)["w"]))
+
+    def test_restore_or_init_stale_epoch_raises(self, tmp_path, _orbax):
+        from mpi4torch_tpu.resilience import restore_or_init
+        from mpi4torch_tpu.utils.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as mgr:
+            mgr.save(0, self._state(0), force=True, epoch=0)
+            mgr.wait_until_finished()
+        with pytest.raises(CommError) as ei:
+            restore_or_init(d, template=self._state(0), expect_epoch=3)
+        assert "epoch 0" in str(ei.value) and "epoch 3" in str(ei.value)
+
+
+# --------------------------------------------------------------------------
+# spare mirrors
+# --------------------------------------------------------------------------
+
+
+class TestSpare:
+    def test_bank_mirror_and_takeover(self):
+        n_data, world = 3, 4
+        bank0 = np.arange(12 * 2, dtype=np.float32).reshape(12, 2)
+
+        def body(rank):
+            slot = rank if rank < n_data else None
+            per = 12 // n_data
+            st = (jnp.asarray(bank0) if slot is None
+                  else jnp.asarray(bank0[slot * per:(slot + 1) * per]))
+            for t in range(2):
+                contrib = (ematrix._delta(t, slot, bank0.shape)
+                           if slot is not None
+                           else np.zeros(bank0.shape, np.float32))
+                st = E.bank_spare_step(mpi.COMM_WORLD, st,
+                                       jnp.asarray(contrib),
+                                       n_data=n_data, slot=slot)
+            return np.asarray(st)
+
+        outs = mpi.run_ranks(body, world, timeout=10.0)
+        oracle = ematrix._bank_oracle(bank0,
+                                      [((0, 1), range(n_data))])
+        per = 12 // n_data
+        for slot in range(n_data):
+            np.testing.assert_array_equal(
+                outs[slot], oracle[slot * per:(slot + 1) * per])
+        # The mirror replicates the full bank, and its takeover slice
+        # of any slot is bitwise the owner's shard.
+        np.testing.assert_array_equal(outs[n_data], oracle)
+        np.testing.assert_array_equal(
+            np.asarray(E.takeover_bank_slot(outs[n_data], 1, n_data)),
+            outs[1])
+
+    def test_zero_mirror_segments_match_owners(self):
+        n_data, world = 4, 5
+        opt = ematrix._Momentum()
+        params0 = {k: np.arange(int(np.prod(s)), dtype=np.float32)
+                   .reshape(s) for k, s in ematrix._ZSHAPES.items()}
+
+        def body(rank):
+            slot = rank if rank < n_data else None
+            p = {k: jnp.asarray(x) for k, x in params0.items()}
+            st = E.zero_spare_init(opt, p, n_data, slot)
+            for t in range(2):
+                grads = ({k: jnp.asarray(x) for k, x in
+                          ematrix._zero_grads(t, slot).items()}
+                         if slot is not None else
+                         {k: jnp.zeros(s, jnp.float32)
+                          for k, s in ematrix._ZSHAPES.items()})
+                p, st = E.zero_spare_step(mpi.COMM_WORLD, opt, p, grads,
+                                          st, n_data=n_data, slot=slot)
+            return ({k: np.asarray(x) for k, x in p.items()}, st)
+
+        outs = mpi.run_ranks(body, world, timeout=15.0)
+        o_params, o_m = ematrix._zero_oracle([((0, 1), range(n_data))])
+        for k in ematrix._ZSHAPES:
+            for r in range(world):
+                np.testing.assert_array_equal(outs[r][0][k],
+                                              o_params[k])
+        taken = E.takeover_shard(
+            outs[n_data][1], 2, n_data,
+            {k: jnp.asarray(x) for k, x in params0.items()})
+        for k in ematrix._ZSHAPES:
+            np.testing.assert_array_equal(np.asarray(taken[k]),
+                                          np.asarray(outs[2][1][k]))
+
+    def test_bad_slots_table_raises(self):
+        def body(rank):
+            return E.zero_spare_step(
+                mpi.COMM_WORLD, ematrix._Momentum(),
+                {"w": jnp.zeros(4)}, {"w": jnp.zeros(4)},
+                {"w": jnp.zeros(2)}, n_data=2, slot=0,
+                slots=(0, 0, None))
+
+        with pytest.raises(E.ElasticError):
+            mpi.run_ranks(body, 3, timeout=5.0)
+
+
+# --------------------------------------------------------------------------
+# serve drain / re-admission
+# --------------------------------------------------------------------------
+
+
+class TestServeDrain:
+    def test_drain_readmit_tokens_bitwise_single_rank(self):
+        from mpi4torch_tpu.serve import Engine, ServeConfig
+
+        cfg = ematrix._serve_cfg()
+        params = ematrix._serve_params(cfg)
+        oracle = ematrix._serve_oracle(cfg, params)
+
+        eng = Engine(cfg, params, ServeConfig(slots=2))
+        for i, (p, n) in enumerate(zip(ematrix._SERVE_PROMPTS,
+                                       ematrix._SERVE_BUDGETS)):
+            eng.submit(np.asarray(p), rid=i, max_new=n)
+        for _ in range(3):
+            eng.step()
+        tickets, results = E.drain_tickets(eng)
+        assert eng.pending() == 0          # drained for real
+        assert any(t.emitted for t in tickets)
+
+        eng2 = Engine(cfg, params, ServeConfig(slots=2))
+        E.readmit(eng2, tickets)
+        results.update(eng2.run())
+        stitched = E.stitched_results(results, tickets)
+        for i in oracle:
+            np.testing.assert_array_equal(
+                np.asarray(stitched[i], np.int64),
+                np.asarray(oracle[i], np.int64))
+
+    def test_snapshot_is_nondestructive(self):
+        from mpi4torch_tpu.serve import Engine, ServeConfig
+
+        cfg = ematrix._serve_cfg()
+        params = ematrix._serve_params(cfg)
+        eng = Engine(cfg, params, ServeConfig(slots=2))
+        eng.submit(np.asarray([3, 4, 5]), rid="a", max_new=4)
+        eng.step()
+        before = eng.pending()
+        recs = eng.snapshot_inflight()
+        assert eng.pending() == before
+        assert recs and recs[0]["rid"] == "a"
+        assert list(recs[0]["emitted"])    # progress captured
+
+    def test_drained_rid_reusable(self):
+        from mpi4torch_tpu.serve import Engine, ServeConfig
+
+        cfg = ematrix._serve_cfg()
+        params = ematrix._serve_params(cfg)
+        eng = Engine(cfg, params, ServeConfig(slots=2))
+        eng.submit(np.asarray([3, 4]), rid="a", max_new=3)
+        eng.step()
+        eng.drain()
+        # The drained rid left this engine's ledger: re-admission (on
+        # this or another engine) must not collide.
+        eng.submit(np.asarray([3, 4, 5]), rid="a", max_new=2)
+
+
+# --------------------------------------------------------------------------
+# the grow-after-shrink round-trip (the satellite)
+# --------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    N_SAMPLES = 24
+
+    def _sample_grads(self, t):
+        return {k: np.sum([ematrix._delta(t * 31 + s, s, shape)
+                           for s in range(self.N_SAMPLES)], axis=0)
+                for k, shape in ematrix._ZSHAPES.items()}
+
+    def _local_grads(self, t, view, pos):
+        per = self.N_SAMPLES // view.size
+        out = {}
+        for k, shape in ematrix._ZSHAPES.items():
+            out[k] = np.sum(
+                [ematrix._delta(t * 31 + s, s, shape)
+                 for s in range(pos * per, (pos + 1) * per)], axis=0)
+        return out
+
+    def test_zero_state_bitwise_vs_never_failed_oracle(self):
+        """(8,)→(6,)→(8,): the same 24-sample global batch dealt to
+        whatever membership is current, SUM reduction — dyadic-exact,
+        so the never-failed 8-world oracle is bit-for-bit the law for
+        every world the schedule visits."""
+        from mpi4torch_tpu.parallel.zero import zero_step
+
+        opt = ematrix._Momentum()
+        params0 = {k: np.arange(int(np.prod(s)), dtype=np.float32)
+                   .reshape(s) for k, s in ematrix._ZSHAPES.items()}
+        rt = E.ElasticRuntime(8, probe_timeout=0.5, world_timeout=20.0)
+
+        def phase(params_in, states, view, ts):
+            def body(pos, rid):
+                p = {k: jnp.asarray(x) for k, x in params_in.items()}
+                st = states[rid]
+                for t in ts:
+                    g = {k: jnp.asarray(x) for k, x in
+                         self._local_grads(t, view, pos).items()}
+                    p, st = zero_step(mpi.COMM_WORLD, opt, p, g, st,
+                                      mean=False)
+                return ({k: np.asarray(x) for k, x in p.items()},
+                        {k: np.asarray(x) for k, x in st.items()})
+            return rt.run_phase(body)
+
+        view0 = rt.view
+        states = {rid: {k: jnp.zeros(
+            (-(-int(np.prod(s)) // 8),), jnp.float32)
+            for k, s in ematrix._ZSHAPES.items()} for rid in view0.alive}
+        res = phase(params0, states, view0, (0, 1))
+        params = res[0][0]
+        states = {view0.alive[p]: {k: jnp.asarray(res[p][1][k])
+                                   for k in ematrix._ZSHAPES}
+                  for p in range(8)}
+
+        # Planned descale (no fault): drain 8 -> 6 with the live replan.
+        def drain_body(pos, rid, old_view, new_view):
+            out = E.replan_zero(mpi.COMM_WORLD, states[rid], params0,
+                                old_view, new_view, mode="drain")
+            return {k: np.asarray(x) for k, x in out.items()}
+
+        outs = rt.drain(drain_body, leaving=[2, 7])
+        view1 = rt.view
+        assert view1.size == 6
+        states = {rid: {k: jnp.asarray(outs[view0.position(rid)][k])
+                        for k in ematrix._ZSHAPES}
+                  for rid in view1.alive}
+        res = phase(params, states, view1, (2,))
+        params = res[0][0]
+        states = {view1.alive[p]: {k: jnp.asarray(res[p][1][k])
+                                   for k in ematrix._ZSHAPES}
+                  for p in range(6)}
+
+        # Grow back to 8; joiners receive their shards on the wire.
+        view2 = rt.consensus(joining=[2, 7])
+        assert view2.size == 8 and view2.epoch == 2
+
+        def grow_body(pos, rid):
+            if rid in view1.alive:
+                st = states[rid]
+            else:
+                st = {k: jnp.zeros(
+                    (-(-int(np.prod(s)) // 6),), jnp.float32)
+                    for k, s in ematrix._ZSHAPES.items()}
+            out = E.replan_zero(mpi.COMM_WORLD, st, params0, view1,
+                                view2, mode="grow")
+            return {k: np.asarray(x) for k, x in out.items()}
+
+        res = rt.run_phase(grow_body)
+        states = {view2.alive[p]: {k: jnp.asarray(res[p][k])
+                                   for k in ematrix._ZSHAPES}
+                  for p in range(8)}
+        res = phase(params, states, view2, (3,))
+        params = res[0][0]
+        states = {view2.alive[p]: res[p][1] for p in range(8)}
+
+        # The NEVER-FAILED oracle: four steps on the 8-world, same
+        # global batch — numpy, replicated.
+        o_params = dict(params0)
+        o_m = {k: np.zeros(s, np.float32)
+               for k, s in ematrix._ZSHAPES.items()}
+        for t in range(4):
+            g = self._sample_grads(t)
+            for k in ematrix._ZSHAPES:
+                o_m[k] = o_m[k] * 0.5 + g[k]
+                o_params[k] = o_params[k] + o_m[k] * (-0.25)
+        for k in ematrix._ZSHAPES:
+            np.testing.assert_array_equal(params[k], o_params[k])
+        for rid in view2.alive:
+            j = view2.position(rid)
+            for k in ematrix._ZSHAPES:
+                np.testing.assert_array_equal(
+                    np.asarray(states[rid][k]),
+                    ematrix._np_shard(o_m[k], 8, j))
+
+
+# --------------------------------------------------------------------------
+# the elastic matrix
+# --------------------------------------------------------------------------
+
+
+_FAST_CELLS = [
+    ("preempt", "plain", "shrink"),
+    ("rank_death", "plain", "spare"),
+    ("preempt", "zero", "shrink"),
+    ("rank_death", "moe", "shrink"),
+]
+
+
+class TestMatrixFast:
+    @pytest.mark.parametrize("kind,subsystem,action", _FAST_CELLS)
+    def test_cell(self, kind, subsystem, action):
+        rec = ematrix.run_cell(kind, subsystem, action)
+        assert rec["status"] == "ok", rec["detail"]
+        assert kind in rec["fired"]
+
+
+@pytest.mark.slow
+class TestMatrixFull:
+    def test_every_cell(self):
+        failures = []
+        for key in sorted(ematrix.COVERAGE):
+            rec = ematrix.run_cell(*key)
+            if rec["status"] != "ok":
+                failures.append((key, rec["detail"]))
+        for kind in sorted(ematrix.EXPECTED_CONSENSUS_ERROR):
+            rec = ematrix.run_consensus_cell(kind)
+            if rec["status"] != "ok":
+                failures.append((kind, rec["detail"]))
+        assert not failures, failures
