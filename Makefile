@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -156,6 +156,23 @@ elastic-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.elastic --smoke
+
+# CPU smoke run of the collective-schedule IR + compiler
+# (mpi4torch_tpu.csched): the re-expression matrix — every registered
+# allreduce algorithm's IR lowering pinned BIT-IDENTICAL (forward and
+# transposition-derived backward StableHLO text, deterministic and
+# not) against the hand-written form on the 8-virtual-device mesh,
+# interpreter-vs-rendezvous-fold bitwise parity, the q8 codec leg as a
+# per-step program rewrite, the tree Bcast_/Reduce_ transposition
+# pair, the step-kind/program registry-sync guard, and one
+# synthesized-schedule census verdict (the search winner beats the
+# hand-written deterministic ring on wire bytes, with its predicted
+# HLO census matched EXACTLY against analyze.parse of the actual
+# lowering).  Exits non-zero on any divergence.
+ir-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.csched --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

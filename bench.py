@@ -1871,6 +1871,53 @@ def _bench_train_step(on_tpu: bool, peak: float):
     }
 
 
+def _bench_schedule_synthesis(on_tpu: bool):
+    """Schedule synthesis (mpi4torch_tpu.csched.synth): the
+    deterministic synthesized-vs-ring census sweep.  For each (world
+    shape, size bucket) the census-ranked winner of the bounded IR
+    program family is compared against the hand-written DETERMINISTIC
+    ring (the ordered fold — the schedule a synthesized winner actually
+    replaces) on wire bytes per rank and sequential steps; the verdict
+    is hardware-independent (the repo's census regression currency), so
+    it is recorded even when no TPU is attached."""
+    import jax
+
+    from mpi4torch_tpu import csched
+
+    ndev = len(jax.devices())
+    worlds = sorted({ndev, max(2, ndev // 2), 2} - {0, 1})
+    sizes = (1 << 10, 1 << 14, 1 << 18, 1 << 22)
+    entries = {}
+    any_beats = False
+    for n in worlds:
+        per = {}
+        for nbytes in sizes:
+            res = csched.synthesize(n, nbytes, 4)
+            beats = bool(res["synthesis_beats_ring"])
+            any_beats = any_beats or beats
+            per[str(nbytes)] = {
+                "winner": res["winner"],
+                "chain": res["chain"],
+                "wire_bytes_per_rank":
+                    res["census"]["wire_bytes_per_rank"],
+                "seq_steps": res["census"]["seq_steps"],
+                "ring_wire_bytes_per_rank":
+                    res["ring_census"]["wire_bytes_per_rank"],
+                "ring_seq_steps": res["ring_census"]["seq_steps"],
+                "wire_advantage": round(
+                    res["ring_census"]["wire_bytes_per_rank"]
+                    / max(1, res["census"]["wire_bytes_per_rank"]), 3),
+                "synthesis_beats_ring": beats,
+            }
+        entries[str(n)] = per
+    return {
+        "mode": "deterministic census sweep (wire bytes / seq steps)",
+        "worlds": worlds,
+        "entries": entries,
+        "synthesis_beats_ring": any_beats,
+    }
+
+
 def _guarded(name: str, fn, *args):
     """Run one sub-bench; on ANY failure return an error stanza instead of
     propagating (a completed earlier measurement must survive a later
@@ -1953,6 +2000,8 @@ def main() -> None:
         rsh = _guarded("reshard", _bench_reshard, on_tpu)
         ela = _guarded("elastic", _bench_elastic, on_tpu)
         srv = _guarded("serve", _bench_serve, on_tpu)
+        syn = _guarded("schedule_synthesis", _bench_schedule_synthesis,
+                       on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -1991,6 +2040,7 @@ def main() -> None:
             "reshard": rsh,
             "elastic": ela,
             "serve": srv,
+            "schedule_synthesis": syn,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

@@ -25,9 +25,9 @@ from pathlib import Path
 DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism", "serving",
-         "compression", "fusion", "algorithms", "overlap", "resilience",
-         "reshard", "elasticity", "analysis", "observability",
-         "api_reference",
+         "compression", "fusion", "algorithms", "schedule_ir", "overlap",
+         "resilience", "reshard", "elasticity", "analysis",
+         "observability", "api_reference",
          "design_tpu", "glossary"]
 
 CSS = """

@@ -71,6 +71,7 @@ from . import resilience
 from . import reshard
 from . import serve
 from . import analyze
+from . import csched
 from . import obs
 from . import elastic
 from .config import (algorithm_scope, compression_scope, fusion_scope,
@@ -123,6 +124,7 @@ __all__ = [
     "reshard",
     "serve",
     "analyze",
+    "csched",
     "obs",
     "elastic",
     "SpmdWaitHandle",

@@ -34,6 +34,7 @@ __all__ = [
     "serve_policy_problems",
     "tune_problems",
     "overlap_split_phase_problems",
+    "csched_problems",
     "standing_problems",
 ]
 
@@ -233,6 +234,46 @@ def overlap_split_phase_problems(census_covered: Iterable) -> List[str]:
     return problems
 
 
+# ----------------------------------------------------------------- csched
+
+def csched_problems() -> List[str]:
+    """Schedule-IR registry sync (ISSUE 14): every registered collective
+    algorithm either declares an IR program (csched.PROGRAM_ALGORITHMS)
+    or an explicit native exemption, and every IR step kind is covered
+    by the lowering, interpreter, transposition AND census dispatch
+    tables — so extending the grammar without extending a table, or
+    registering an algorithm outside the IR, fails ``make
+    analyze-smoke`` (and ``make ir-smoke``) structurally."""
+    from .. import csched, tune
+
+    problems: List[str] = []
+    registered = set(tune.available_algorithms())
+    declared = set(csched.PROGRAM_ALGORITHMS) | set(csched.NATIVE_EXEMPT)
+    missing = sorted(registered - declared)
+    if missing:
+        problems.append(
+            f"algorithm(s) {missing} registered without an IR program "
+            "or a csched.NATIVE_EXEMPT entry — every schedule must "
+            "re-express through the IR or be exempted explicitly")
+    stale = sorted(declared - registered)
+    if stale:
+        problems.append(
+            f"csched declares program(s)/exemption(s) {stale} for "
+            "algorithms the tune registry no longer knows")
+    kinds = set(csched.STEP_KINDS)
+    for table, covered in (
+            ("lowering", csched.lowering_covers()),
+            ("interpreter", csched.interpreter_covers()),
+            ("transposition", csched.transposition_covers()),
+            ("census", csched.census_covers())):
+        problems += set_drift(
+            kinds, covered,
+            "IR step-kind registry {registered} out of sync with the "
+            + table + " dispatch table {covered} — every step kind "
+            "needs " + table + " coverage")
+    return problems
+
+
 # ------------------------------------------------------------- everything
 
 def standing_problems() -> List[str]:
@@ -245,6 +286,7 @@ def standing_problems() -> List[str]:
     problems = [f"resilience: {p}" for p in resilience_problems()]
     problems += [f"elastic: {p}" for p in elastic_problems()]
     problems += [f"reshard: {p}" for p in reshard_step_problems()]
+    problems += [f"csched: {p}" for p in csched_problems()]
     from ..serve.__main__ import PARITY_POLICIES
     problems += [f"serve: {p}"
                  for p in serve_policy_problems(PARITY_POLICIES)]

@@ -269,7 +269,14 @@ def _fused_allreduce_value(ctx, x, codec: Codec, algorithm: str,
     element range (disjoint halves at ``constants.multipath_split``);
     ``q8_ef`` residual rounds ride the same channel as the values they
     correct.  ``reverse`` swaps ``bidir``'s channel directions (the
-    backward pass)."""
+    backward pass).
+
+    Since ISSUE 14 this hand-composed form is the bit-identity
+    REFERENCE (`make ir-smoke` pins it): production traffic routes
+    through the IR instead — :func:`_allreduce_value` rewrites the
+    algorithm's exact program with per-step ``q8_ring_channel`` steps
+    (csched.rewrite_codec) and lowers them through the one emitter,
+    whose channel bodies are this module's :func:`_fused_channel`."""
     base = codec.base()
     n = ctx.size
     shape, dtype = x.shape, x.dtype
@@ -306,7 +313,17 @@ def _allreduce_value(ctx, x, codec: Codec, algorithm: str = "ring",
         return x
     base = codec.base()
     if getattr(base, "hop_fused", False):
-        return _fused_allreduce_value(ctx, x, codec, algorithm, reverse)
+        # The in-schedule pipeline as a PROGRAM REWRITE: the exact
+        # algorithm's IR program with every multipath channel replaced
+        # by a q8_ring_channel step, lowered by the one csched emitter
+        # — bit-identical to _fused_allreduce_value (pinned by
+        # `make ir-smoke`), with the per-algorithm channel forks gone.
+        from .. import csched
+
+        prog = csched.q8_allreduce_program(algorithm, ctx.size,
+                                           codec.name, base.block,
+                                           reverse=reverse)
+        return csched.lower_q8_allreduce(prog, ctx, x, codec)
     if codec.ef_rounds <= 1:
         return _allreduce_round(ctx, x, base, salt=0)
     # In-call error feedback: round 1 tracks every quantization residual

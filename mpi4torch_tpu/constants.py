@@ -188,16 +188,23 @@ def reduce_grouped(op, values, group: int):
     Matches the deterministic form of the SPMD ``hier`` schedule
     (ops/spmd.py ``_hier_allreduce_value``), where groups are
     consecutive runs along the axis (the intra-tier of a 2-level
-    topology)."""
+    topology).  Since ISSUE 14 the fold body is the schedule-IR
+    interpreter's one ``level_fold`` path (csched.interp) — the same
+    code that executes the hier program for the eager rendezvous
+    backend — so this helper, :func:`reduce_torus`, and the eager
+    hier/torus legs can never drift apart."""
     vals = list(values)
     n = len(vals)
     if group < 1 or n % group:
         raise ValueError(
             f"reduce_grouped needs group ({group}) to divide the rank "
             f"count ({n})")
-    partials = [reduce_ordered(op, vals[b:b + group])
-                for b in range(0, n, group)]
-    return reduce_ordered(op, partials)
+    from .csched.interp import level_fold_groups
+    from .csched.programs import _hier_groups
+
+    inner, outer, _ = _hier_groups(n, group)
+    return level_fold_groups(
+        outer, op, level_fold_groups(inner, op, vals))[0]
 
 
 def multipath_split(total: int) -> int:
@@ -233,21 +240,25 @@ def reduce_torus(op, values, inner: int):
         raise ValueError(
             f"reduce_torus needs inner ({inner}) to divide the rank "
             f"count ({n})")
-    outer = n // inner
-    shape = vals[0].shape
-    flats = [v.reshape(-1) for v in vals]
-    total = flats[0].size
-    m = multipath_split(total)
-    h0 = reduce_grouped(op, [f[:m] for f in flats], inner)
-    if m >= total:
-        return h0.reshape(shape)
-    # Column-major rank order: consecutive runs of the transposed list
-    # are the outer-axis groups, so one grouped fold serves both halves.
-    perm = [o * inner + i for i in range(inner) for o in range(outer)]
-    h1 = reduce_grouped(op, [flats[p][m:] for p in perm], outer)
-    import numpy as _np
-    xp = _np if isinstance(h0, _np.ndarray) else jnp
-    return xp.concatenate([h0, h1]).reshape(shape)
+    if n == 1:
+        return vals[0]
+    # The fold IS the torus program's interpretation (ISSUE 14 dedupe):
+    # the deterministic torus channels — half 0 grouped (inner-axis
+    # first), half 1 the transposed grid — executed by the schedule-IR
+    # interpreter's one level_fold path, the same code the eager
+    # rendezvous backend folds with for algorithm="torus".
+    from .csched.interp import interpret_allreduce
+    from .csched.ir import Phase, Program, Step
+    from .csched.programs import _hier_groups
+
+    inner_groups, outer_groups, outer_n = _hier_groups(n, inner)
+    ch0 = (Step("level_fold", (inner_groups, inner), span=("half", 0)),
+           Step("level_fold", (outer_groups, outer_n), span=("half", 0)))
+    ch1 = (Step("level_fold", (outer_groups, outer_n), span=("half", 1)),
+           Step("level_fold", (inner_groups, inner), span=("half", 1)))
+    prog = Program("allreduce", "torus", n,
+                   (Phase("multipath", ch0 + ch1),))
+    return interpret_allreduce(prog, op, vals)
 
 
 def multipath_ring_orders(n: int, algorithm, *, inner=None,

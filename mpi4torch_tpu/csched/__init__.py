@@ -1,0 +1,70 @@
+"""Collective-schedule IR + compiler (GC3-style, arXiv:2201.11840).
+
+One program grammar for every schedule: algorithms are typed IR
+programs (:mod:`.ir`), ONE lowering emits the compiled Mode A schedule
+(:mod:`.lower`), ONE transposition rule derives every backward
+(:func:`.ir.transpose`), ONE interpreter is the Mode B /
+deterministic-mode fold oracle (:mod:`.interp`), ONE census generator
+produces the analyze-grade wire/step/HLO accounting (:mod:`.census`),
+and schedule *synthesis* is a search over programs (:mod:`.synth`) —
+replacing the seven hand-maintained per-algorithm forks of
+``ops/spmd.py``/``ops/eager.py``/``constants.py``/``compress/`` that
+grew up independently.
+
+``python -m mpi4torch_tpu.csched --smoke`` (``make ir-smoke``) runs
+the re-expression matrix — every registered algorithm's IR lowering
+pinned bit-identical (lowered text + Mode A/B values) against the
+hand-written forms — plus the registry-sync guard and a
+synthesized-schedule census verdict.
+"""
+
+from __future__ import annotations
+
+from .census import census_covers, program_census
+from .interp import interpret_allreduce, interpreter_covers, \
+    level_fold_groups
+from .ir import (Phase, Program, STEP_KINDS, Step, transpose,
+                 transposition_covers)
+from .lower import (lower_allreduce, lower_q8_allreduce, lower_value,
+                    lowering_covers)
+from .programs import (NATIVE_EXEMPT, PROGRAM_ALGORITHMS,
+                       allreduce_program, bcast_program, has_program,
+                       q8_allreduce_program, reduce_program,
+                       rewrite_codec)
+from .synth import (autotune_synthesis, factorization_chains,
+                    fold_program, install, installed_program,
+                    is_synth_name, synth_applicable, synthesize)
+
+__all__ = [
+    "Program", "Phase", "Step", "STEP_KINDS", "transpose",
+    "allreduce_program", "bcast_program", "reduce_program",
+    "q8_allreduce_program", "rewrite_codec", "has_program",
+    "PROGRAM_ALGORITHMS", "NATIVE_EXEMPT",
+    "lower_allreduce", "lower_value", "lower_q8_allreduce",
+    "interpret_allreduce", "level_fold_groups",
+    "program_census",
+    "synthesize", "fold_program", "factorization_chains",
+    "autotune_synthesis", "install", "installed_program",
+    "is_synth_name", "synth_applicable",
+    "lowering_covers", "interpreter_covers", "transposition_covers",
+    "census_covers",
+    "declared_vjp_census",
+]
+
+
+def declared_vjp_census(algorithm: str, nranks: int = 8) -> str:
+    """The VJP-symmetry declaration DERIVED from the transposition
+    rule (feeding ``AlgorithmSpec.vjp_census`` structurally): ``"self"``
+    when the transposed program's census equals the forward's — true
+    for every shipped allreduce schedule, since allreduce(SUM) is
+    self-adjoint and direction flips preserve the census."""
+    import jax.numpy as jnp
+
+    from .. import constants as C
+
+    prog = allreduce_program(algorithm, nranks, C.MPI_SUM,
+                             deterministic=False, nelems=1024,
+                             itemsize=jnp.dtype(jnp.float32).itemsize)
+    fwd = program_census(prog, 1024, 4)
+    bwd = program_census(transpose(prog), 1024, 4)
+    return "self" if fwd == bwd else {"mismatch": (fwd, bwd)}

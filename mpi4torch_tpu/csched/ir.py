@@ -1,0 +1,273 @@
+"""The collective-schedule IR: one program grammar for every schedule.
+
+Follow GC3 (PAPERS.md, arXiv:2201.11840): a collective algorithm is a
+*program* — a sequence of :class:`Phase`\\ s of :class:`Step`\\ s drawn
+from ONE closed step grammar — instead of a hand-maintained fork of
+lowering + VJP + eager fold + census code per algorithm.  Everything
+else in the package dispatches over :data:`STEP_KINDS`:
+
+* :mod:`.lower`   — the one Mode A emitter (``collective_permute`` /
+  ``lax.scan`` schedules over a mesh axis);
+* :mod:`.interp`  — the one Mode B / deterministic-mode fold oracle;
+* :func:`transpose` (here) — the one rule deriving every backward
+  program from the forward program;
+* :mod:`.census`  — the one analyze-grade wire/step/HLO accounting;
+* :mod:`.synth`   — schedule synthesis as a search over IR programs.
+
+The grammar (closed — the registry-sync guard
+``analyze.registry.csched_problems`` fails when a kind exists without
+lowering + interpreter + transposition + census coverage):
+
+=================== ==================================================
+kind                 meaning (params)
+=================== ==================================================
+``native_allreduce`` XLA's native whole-axis collective — ``lax.psum``
+                     / ``pmax`` / ``pmin`` by reduction op ``()``
+``level_fold``       all-gather over a rank grouping + ascending fold
+                     — one tier of an ordered deterministic reduction
+                     ``(groups|None, fold_count)``
+``ring_fold``        the scan-pipelined chunked deterministic ring
+                     (ops/spmd ``_ring_fold_allreduce``) ``()``
+``butterfly``        the recursive-halving/doubling exchange schedule
+                     (power-of-two worlds) ``()``
+``tree_reduce``      binomial reduce-to-root rounds + root mask
+                     ``(root,)``
+``tree_bcast``       root mask + binomial broadcast rounds ``(root,)``
+``mask_root``        zero every non-root rank's value ``(root,)``
+``ring_chain``       one directional exact RS+AG ``collective_permute``
+                     ring chain (the ``bidir`` half) ``(direction,)``
+``grouped_sum``      the native 2-level triple: grouped reduce-scatter
+                     → grouped allreduce → grouped all-gather
+                     ``(g, rs_groups, ar_groups, ag_groups)``
+``q8_ring_channel``  a codec-rewritten in-schedule quantized ring
+                     channel (compress/spmd ``_fused_channel``)
+                     ``(sigma_spec, direction, channel, reversible)``
+=================== ==================================================
+
+``Step.span`` places a step on the whole payload (``"all"``) or on a
+multipath half (``("half", k)`` — split at
+:func:`constants.multipath_split`, the shared Mode A/B rule).
+``Step.codec`` is the per-step codec-hop annotation: the codec rewrite
+(:func:`.programs.rewrite_codec`) replaces exact channel steps with
+``q8_ring_channel`` steps carrying it, so compression is a program
+transformation instead of a per-algorithm fork.
+
+Transposition rule (:func:`transpose`): allreduce programs are
+self-adjoint — the backward is the same program with every directional
+step's ring direction reversed (``ring_chain`` and reversible
+``q8_ring_channel`` flip; everything else is order- and kind-fixed) —
+while root collectives (bcast/reduce) reverse their phase/step list
+under the kind map ``tree_reduce ↔ tree_bcast`` (``mask_root`` and
+``native_allreduce`` are self-adjoint), the PR 8 reversed-step-list
+discipline.  Both fixed points are proved structurally in
+doc/schedule_ir.md and pinned by ``make ir-smoke``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..runtime import CommError
+
+# The closed step grammar.  Extending it means extending the lowering,
+# interpreter, transposition and census dispatch tables — the
+# csched_problems registry guard fails `make analyze-smoke` otherwise.
+STEP_KINDS = (
+    "native_allreduce",
+    "level_fold",
+    "ring_fold",
+    "butterfly",
+    "tree_reduce",
+    "tree_bcast",
+    "mask_root",
+    "ring_chain",
+    "grouped_sum",
+    "q8_ring_channel",
+)
+
+# Phase kinds: "seq" runs its steps in order on the whole payload;
+# "multipath" stripes the flat payload across per-span channels
+# (disjoint halves at constants.multipath_split) whose step
+# sub-sequences are independent — XLA schedules them concurrently;
+# "q8_multipath" is the codec-rewritten multipath form (f32 wire
+# staging + final astype, matching compress/spmd's fused pipeline).
+PHASE_KINDS = ("seq", "multipath", "q8_multipath")
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+@dataclass(frozen=True)
+class Step:
+    """One step of a schedule program.  ``params`` are static,
+    JSON-serializable kind-specific arguments (group tables, roots,
+    ring directions); ``span`` places the step on the payload;
+    ``codec`` is the codec-hop annotation (None = exact wire)."""
+
+    kind: str
+    params: Tuple = ()
+    span: object = "all"          # "all" | ("half", k)
+    codec: Optional[str] = None
+
+    def __post_init__(self):
+        if self.kind not in STEP_KINDS:
+            raise CommError(
+                f"unknown IR step kind {self.kind!r}; the grammar is "
+                f"closed over {STEP_KINDS}")
+        object.__setattr__(self, "params", _freeze(self.params))
+        object.__setattr__(self, "span", _freeze(self.span))
+
+
+@dataclass(frozen=True)
+class Phase:
+    kind: str
+    steps: Tuple[Step, ...] = ()
+
+    def __post_init__(self):
+        if self.kind not in PHASE_KINDS:
+            raise CommError(
+                f"unknown IR phase kind {self.kind!r}; expected one of "
+                f"{PHASE_KINDS}")
+        object.__setattr__(self, "steps", tuple(self.steps))
+
+
+@dataclass(frozen=True)
+class Program:
+    """A typed schedule program: ``collective`` names the op family the
+    program computes, ``algorithm`` the source schedule name (a
+    registered algorithm or ``synth``), ``nranks`` the world the
+    program was built for (programs are world-specialized, like the
+    schedules they express), ``codec`` the wire codec after a codec
+    rewrite (None = exact)."""
+
+    collective: str
+    algorithm: str
+    nranks: int
+    phases: Tuple[Phase, ...] = ()
+    codec: Optional[str] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "phases", tuple(self.phases))
+
+    # -- structural accounting -------------------------------------------
+    def steps(self) -> Tuple[Step, ...]:
+        return tuple(s for ph in self.phases for s in ph.steps)
+
+    @property
+    def nsteps(self) -> int:
+        return len(self.steps())
+
+    # -- serialization ----------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "collective": self.collective,
+            "algorithm": self.algorithm,
+            "nranks": self.nranks,
+            "codec": self.codec,
+            "phases": [
+                {"kind": ph.kind,
+                 "steps": [
+                     {"kind": s.kind, "params": s.params,
+                      "span": s.span, "codec": s.codec}
+                     for s in ph.steps]}
+                for ph in self.phases],
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "Program":
+        phases = tuple(
+            Phase(ph["kind"], tuple(
+                Step(s["kind"], _freeze(s.get("params", ())),
+                     _freeze(s.get("span", "all")), s.get("codec"))
+                for s in ph["steps"]))
+            for ph in data["phases"])
+        return cls(collective=data["collective"],
+                   algorithm=data["algorithm"],
+                   nranks=int(data["nranks"]),
+                   phases=phases, codec=data.get("codec"))
+
+    def digest(self) -> str:
+        """Canonical content digest — the identity of a synthesized
+        program in the tune cache (``synth:<digest>``)."""
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":"), default=list)
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:10]
+
+
+# ---------------------------------------------------------------------------
+# Transposition
+# ---------------------------------------------------------------------------
+
+# Kind map applied when a root collective's step list is reversed.
+# Every step kind must have an entry — csched_problems checks this
+# table alongside the lowering/interpreter/census dispatch tables.
+TRANSPOSE_KINDS = {
+    "native_allreduce": "native_allreduce",
+    "level_fold": "level_fold",
+    "ring_fold": "ring_fold",
+    "butterfly": "butterfly",          # halve↔double reversal fixed point
+    "tree_reduce": "tree_bcast",
+    "tree_bcast": "tree_reduce",
+    "mask_root": "mask_root",
+    "ring_chain": "ring_chain",        # + direction flip (below)
+    "grouped_sum": "grouped_sum",      # RS↔AG reversal fixed point
+    "q8_ring_channel": "q8_ring_channel",  # + flip when reversible
+}
+
+
+def _flip_step(step: Step) -> Step:
+    """Directional adjoint of one step: ring chains reverse their ring
+    direction (the adjoint of a ring segment is the reverse-direction
+    ring), reversible quantized channels likewise; every other kind is
+    direction-free."""
+    if step.kind == "ring_chain":
+        (d,) = step.params
+        return Step("ring_chain", (-d,), step.span, step.codec)
+    if step.kind == "q8_ring_channel":
+        sigma, d, k, reversible = step.params
+        if reversible:
+            return Step("q8_ring_channel", (sigma, -d, k, reversible),
+                        step.span, step.codec)
+    return step
+
+
+def transpose(program: Optional[Program]) -> Optional[Program]:
+    """THE backward-derivation rule (the PR 8 ``adjoint()`` discipline
+    generalized).  Sum-allreduce programs are self-adjoint: the
+    backward is the same program with each directional step flipped
+    (``bidir``'s counter-rotating chains swap directions; everything
+    else is its own adjoint — the rhd halve/double and hier RS/AR/AG
+    step lists are palindromic under reversal + kind transpose, so the
+    in-place form below is the normalized fixed point).  Root
+    collectives (bcast/reduce) reverse their phase and step lists under
+    :data:`TRANSPOSE_KINDS` — ``transpose(bcast) == reduce`` and back,
+    per tree round and per masked-psum pair."""
+    if program is None:
+        return None
+    if program.collective == "allreduce":
+        phases = tuple(
+            Phase(ph.kind, tuple(_flip_step(s) for s in ph.steps))
+            for ph in program.phases)
+        return Program(program.collective, program.algorithm,
+                       program.nranks, phases, program.codec)
+    phases = tuple(
+        Phase(ph.kind, tuple(
+            Step(TRANSPOSE_KINDS[s.kind], s.params, s.span, s.codec)
+            for s in reversed(ph.steps)))
+        for ph in reversed(program.phases))
+    collective = {"bcast": "reduce", "reduce": "bcast"}.get(
+        program.collective, program.collective)
+    return Program(collective, program.algorithm, program.nranks,
+                   phases, program.codec)
+
+
+def transposition_covers() -> Tuple[str, ...]:
+    """Step kinds the transposition table serves (the registry guard's
+    coverage probe)."""
+    return tuple(TRANSPOSE_KINDS)

@@ -1,0 +1,303 @@
+"""Registered IR programs: one builder per collective algorithm.
+
+Each builder re-expresses a hand-written schedule (ops/spmd.py) as an
+IR program whose one-emitter lowering (:mod:`.lower`) is BIT-IDENTICAL
+— same StableHLO text — to the original form, pinned by
+``make ir-smoke`` and tests/test_csched.py.  Builders mirror the
+original dispatch decisions exactly (op cases, deterministic mode, the
+size thresholds from :mod:`mpi4torch_tpu.config`, applicability
+raises), so a program is a pure function of the same static call data
+the hand-written fork read — ``run_spmd``'s jit cache key already
+covers all of it.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .. import config as _config
+from .. import constants as C
+from ..runtime import CommError
+from .ir import Phase, Program, Step
+
+# Algorithms with a registered IR program builder.  An algorithm
+# registered in tune.registry must appear here or in NATIVE_EXEMPT —
+# the csched_problems registry-sync guard enforces it.
+PROGRAM_ALGORITHMS = ("ring", "rhd", "tree", "hier", "bidir", "torus")
+
+# Registered algorithms explicitly exempted from the IR (none today:
+# all six allreduce schedules re-express through the grammar).
+NATIVE_EXEMPT: Tuple[str, ...] = ()
+
+
+def has_program(algorithm: str) -> bool:
+    return algorithm in PROGRAM_ALGORITHMS or (
+        isinstance(algorithm, str) and algorithm.startswith("synth:"))
+
+
+def _ident(collective: str, algorithm: str, n: int) -> Program:
+    return Program(collective, algorithm, n, ())
+
+
+def _hier_groups(n: int, g: int):
+    ngroups = n // g
+    inner = tuple(tuple(b * g + i for i in range(g))
+                  for b in range(ngroups))
+    outer = tuple(tuple(i + b * g for b in range(ngroups))
+                  for i in range(g))
+    return inner, outer, ngroups
+
+
+def _ordered_fold_program(algorithm: str, n: int, op: int, nelems: int,
+                          itemsize: int) -> Program:
+    """The deterministic ordered-fold dispatch of ops/spmd
+    ``_ordered_fold_allreduce``: the all-gather+fold form below the
+    gather threshold, the chunked scan ring above it."""
+    if n == 1:
+        return _ident("allreduce", algorithm, n)
+    gathered = nelems * itemsize * n
+    if gathered <= _config.ordered_fold_gather_max_bytes():
+        step = Step("level_fold", (None, n))
+    else:
+        step = Step("ring_fold")
+    return Program("allreduce", algorithm, n, (Phase("seq", (step,)),))
+
+
+def allreduce_program(algorithm, n: int, op: int, *, deterministic: bool,
+                      nelems: int, itemsize: int) -> Program:
+    """The IR program computing ``Allreduce(op)`` with ``algorithm`` on
+    an ``n``-rank axis — the branch-for-branch re-expression of
+    ``ops/spmd._allreduce_fwd_value`` and the per-algorithm value
+    functions it dispatched to.  Raises exactly where the hand-written
+    forms raised (rhd on non-power-of-two worlds, hier/torus without a
+    2-level factorization, MINLOC/MAXLOC everywhere)."""
+    algorithm = algorithm or "ring"
+    if isinstance(algorithm, str) and algorithm.startswith("synth:"):
+        from . import synth as _synth
+
+        return _synth.installed_program(algorithm, n)
+
+    if algorithm == "rhd":
+        if n == 1:
+            return _ident("allreduce", "rhd", n)
+        if n & (n - 1):
+            raise CommError(
+                f"the 'rhd' (recursive halving/doubling) schedule needs a "
+                f"power-of-two world; got {n} ranks — use 'tree' for the "
+                "logarithmic schedule at this size, or 'ring'")
+        return Program("allreduce", "rhd", n,
+                       (Phase("seq", (Step("butterfly"),)),))
+
+    if algorithm == "tree":
+        if n == 1:
+            return _ident("allreduce", "tree", n)
+        return Program("allreduce", "tree", n, (Phase("seq", (
+            Step("tree_reduce", (0,)), Step("tree_bcast", (0,)))),))
+
+    if algorithm == "hier":
+        if n == 1:
+            return _ident("allreduce", "hier", n)
+        from ..tune import resolve_hier_group
+
+        g = resolve_hier_group(n)
+        inner, outer, ngroups = _hier_groups(n, g)
+        if op == C.MPI_SUM and not deterministic:
+            return Program("allreduce", "hier", n, (Phase("seq", (
+                Step("grouped_sum", (g, inner, outer, inner)),)),))
+        return Program("allreduce", "hier", n, (Phase("seq", (
+            Step("level_fold", (inner, g)),
+            Step("level_fold", (outer, ngroups)))),))
+
+    if algorithm == "bidir":
+        if n == 1:
+            return _ident("allreduce", "bidir", n)
+        if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+            C.combine2(op, None, None)  # raises with explanation
+        if deterministic:
+            return _ordered_fold_program("bidir", n, op, nelems, itemsize)
+        m = C.multipath_split(nelems)
+        steps = [Step("ring_chain", (1,), span=("half", 0))]
+        if m < nelems:
+            steps.append(Step("ring_chain", (-1,), span=("half", 1)))
+        return Program("allreduce", "bidir", n,
+                       (Phase("multipath", tuple(steps)),))
+
+    if algorithm == "torus":
+        if n == 1:
+            return _ident("allreduce", "torus", n)
+        if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+            C.combine2(op, None, None)  # raises with explanation
+        from ..tune import resolve_hier_group
+
+        g = resolve_hier_group(n)
+        inner, outer, ngroups = _hier_groups(n, g)
+        m = C.multipath_split(nelems)
+        if op == C.MPI_SUM and not deterministic:
+            ch0 = (Step("grouped_sum", (g, inner, outer, inner),
+                        span=("half", 0)),)
+            ch1 = (Step("grouped_sum", (ngroups, outer, inner, outer),
+                        span=("half", 1)),)
+        else:
+            ch0 = (Step("level_fold", (inner, g), span=("half", 0)),
+                   Step("level_fold", (outer, ngroups), span=("half", 0)))
+            ch1 = (Step("level_fold", (outer, ngroups), span=("half", 1)),
+                   Step("level_fold", (inner, g), span=("half", 1)))
+        steps = ch0 + (ch1 if m < nelems else ())
+        return Program("allreduce", "torus", n,
+                       (Phase("multipath", steps),))
+
+    if algorithm == "ring":
+        if op == C.MPI_SUM:
+            if deterministic:
+                return _ordered_fold_program("ring", n, op, nelems,
+                                             itemsize)
+            return Program("allreduce", "ring", n,
+                           (Phase("seq", (Step("native_allreduce"),)),))
+        if op in (C.MPI_MAX, C.MPI_MIN):
+            return Program("allreduce", "ring", n,
+                           (Phase("seq", (Step("native_allreduce"),)),))
+        if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+            C.combine2(op, None, None)  # raises with explanation
+        return _ordered_fold_program("ring", n, op, nelems, itemsize)
+
+    raise CommError(
+        f"no IR program registered for collective algorithm "
+        f"{algorithm!r} (registered: {', '.join(PROGRAM_ALGORITHMS)})")
+
+
+# ---------------------------------------------------------------------------
+# Bcast_/Reduce_ tree and ring forms
+# ---------------------------------------------------------------------------
+
+
+def bcast_program(algorithm, n: int, root: int, *, nbytes: int) -> Program:
+    """The Bcast_ program: ``tree`` pins the binomial-tree form,
+    ``ring`` the root-masked psum pair; ``None`` keeps the size
+    dispatch (``config.bcast_tree_max_bytes``) — exactly
+    ``ops/spmd._bcast_value``."""
+    if n == 1:
+        return _ident("bcast", algorithm or "auto", n)
+    if algorithm == "tree" or (
+            algorithm not in ("ring",)
+            and nbytes <= _config.bcast_tree_max_bytes()):
+        return Program("bcast", "tree", n, (Phase("seq", (
+            Step("tree_bcast", (root,)),)),))
+    return Program("bcast", "ring", n, (Phase("seq", (
+        Step("mask_root", (root,)), Step("native_allreduce"))),))
+
+
+def reduce_program(algorithm, n: int, op: int, root: int, *,
+                   deterministic: bool, nelems: int,
+                   itemsize: int) -> Program:
+    """The Reduce_ program: ``tree`` is the binomial reduce (whose
+    transpose is the tree Bcast_ — the derived-backward pair the
+    acceptance pins); everything else is the allreduce program with a
+    root mask appended, ``ops/spmd._reduce_value``."""
+    if algorithm == "tree":
+        return Program("reduce", "tree", n, (Phase("seq", (
+            Step("tree_reduce", (root,)),)),))
+    base = allreduce_program("ring", n, op, deterministic=deterministic,
+                             nelems=nelems, itemsize=itemsize)
+    steps = tuple(s for ph in base.phases for s in ph.steps)
+    return Program("reduce", "ring", n, (Phase("seq", steps + (
+        Step("mask_root", (root,)),)),))
+
+
+# ---------------------------------------------------------------------------
+# Codec rewrite: compression as a program transformation
+# ---------------------------------------------------------------------------
+
+
+def rewrite_codec(program: Program, codec_name: str,
+                  block: int) -> Program:
+    """Rewrite an exact allreduce program for the in-schedule block-q8
+    pipeline: every multipath channel of the program becomes ONE
+    ``q8_ring_channel`` step annotated with the codec — the per-step
+    codec rewrite that replaces the per-algorithm forks the fused
+    pipeline used to thread by hand.  The channel's ring walk is
+    derived from the program structure: exact ``ring_chain`` steps keep
+    their direction (and stay reversible — ``bidir``'s backward flips
+    them); grouped torus channels ride the transposed-grid walk of
+    :func:`constants.multipath_ring_orders` with the inner group size
+    read off the channel's own first step."""
+    if not program.phases:
+        return Program("allreduce", program.algorithm, program.nranks,
+                       (), codec=codec_name)
+    phase = program.phases[0]
+    steps = []
+    if phase.kind == "seq":
+        # Single-channel program (ring): one identity-walk channel.
+        steps.append(Step("q8_ring_channel", (None, 1, 0, False),
+                          span="all", codec=codec_name))
+    elif phase.kind == "multipath":
+        by_span = {}
+        for s in phase.steps:
+            by_span.setdefault(s.span, []).append(s)
+        spans = sorted(by_span, key=lambda sp: sp[1])
+        # Grouped torus channels: channel 0 walks the grid row-major
+        # (identity), channel 1 column-major — the shared
+        # multipath_ring_orders rule; inner = the row-major channel's
+        # own intra-tier group size, read off the program structure.
+        first0 = by_span[spans[0]][0]
+        inner = None
+        if first0.kind == "grouped_sum":
+            inner = int(first0.params[0])
+        elif first0.kind == "level_fold":
+            inner = int(first0.params[1])
+        for k, span in enumerate(spans):
+            first = by_span[span][0]
+            if first.kind == "ring_chain":
+                (d,) = first.params
+                steps.append(Step("q8_ring_channel", (None, d, k, True),
+                                  span=span, codec=codec_name))
+            elif k == 0:
+                steps.append(Step("q8_ring_channel", (None, 1, 0, False),
+                                  span=span, codec=codec_name))
+            else:
+                if inner is None:
+                    raise CommError(
+                        "torus codec rewrite needs the row-major "
+                        "channel's group size")
+                steps.append(Step(
+                    "q8_ring_channel", (("torus_col", inner), 1, k,
+                                        False),
+                    span=span, codec=codec_name))
+    else:
+        raise CommError(
+            f"codec rewrite does not serve phase kind {phase.kind!r}")
+    return Program("allreduce", program.algorithm, program.nranks,
+                   (Phase("q8_multipath", tuple(steps)),),
+                   codec=codec_name)
+
+
+def q8_allreduce_program(algorithm, n: int, codec_name: str,
+                         block: int, *, reverse: bool = False
+                         ) -> Program:
+    """Build + rewrite in one call: the exact program of ``algorithm``
+    (sum, non-deterministic — the fused pipeline's regime) rewritten
+    for the block-q8 codec; ``reverse`` derives the backward via
+    :func:`.ir.transpose` (``bidir``'s channel directions swap, exactly
+    the hand-written ``reverse=True`` path)."""
+    from .ir import transpose
+
+    # nelems=2 keeps both multipath channels in the program; the
+    # lowering skips the empty half for tiny payloads exactly like the
+    # hand-written pipeline did (the k>0 break).
+    prog = allreduce_program(algorithm, n, C.MPI_SUM,
+                             deterministic=False, nelems=2, itemsize=4)
+    prog = rewrite_codec(prog, codec_name, block)
+    return transpose(prog) if reverse else prog
+
+
+def resolve_sigma(spec, n: int):
+    """Materialize a ``q8_ring_channel`` sigma spec: ``None`` is the
+    identity walk; ``("torus_col", inner)`` the column-major grid walk
+    of :func:`constants.multipath_ring_orders`."""
+    if spec is None:
+        return None
+    tag, inner = spec
+    if tag != "torus_col":
+        raise CommError(f"unknown q8 channel walk {spec!r}")
+    inner = int(inner)
+    outer = n // inner
+    return tuple((p % outer) * inner + p // outer for p in range(n))

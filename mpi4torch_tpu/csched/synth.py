@@ -1,0 +1,261 @@
+"""Schedule synthesis: an autotuner leg searching over IR programs.
+
+The door GC3 / "The Big Send-off" open: once algorithms are programs,
+new schedules are POINTS IN A SEARCH SPACE instead of hand-written
+forks.  The bounded family here is the multi-level grouped ordered
+fold — one ``level_fold`` tier per factor of an ordered factorization
+chain of the world size (the named ``hier`` schedule is exactly the
+2-level member; deeper chains are genuinely new programs).  Candidates
+are scored on the deterministic census (:mod:`.census` — wire bytes,
+then sequential rounds, then digest for a stable tie-break), so
+synthesis is a pure function of ``(nranks, nbytes bucket)``: the same
+inputs always pick the same winner.
+
+Winners are cached under the existing tune cache key like algorithms
+today: the entry's algorithm name is ``synth:<digest>`` and the entry
+carries the serialized program, which installs into the in-process
+registry on lookup — so a later process lowers/interprets the winner
+with zero re-search.  ``select_auto`` honors installed synthesized
+winners in deterministic mode (where the grouped fold family beats the
+ordered gather fold's ``(N-1)·S`` wire); wall-clock-measured non-det
+selection ignores them (a det-census verdict must not steer it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..runtime import CommError
+from .census import program_census
+from .ir import Phase, Program, Step
+
+# In-process registry of installed synthesized programs, keyed by the
+# full cache name ("synth:<digest>").  Entries arrive from synthesis
+# runs in this process or from persisted tune-cache entries on lookup.
+_INSTALLED: Dict[str, Program] = {}
+
+SYNTH_PREFIX = "synth:"
+
+# Search bound: factorization chains up to this many tiers.  Every
+# chain member costs (factor-1)·S wire, so useful depth is log2(n);
+# 4 tiers cover worlds to 16 ranks exhaustively.
+MAX_LEVELS = 4
+
+
+def is_synth_name(name) -> bool:
+    return isinstance(name, str) and name.startswith(SYNTH_PREFIX)
+
+
+def factorization_chains(n: int, max_levels: int = MAX_LEVELS
+                         ) -> List[Tuple[int, ...]]:
+    """Ordered factorizations of ``n`` into factors >= 2 (up to
+    ``max_levels`` factors), sorted for determinism.  ``(n,)`` — the
+    single flat tier — is always a member."""
+    out = set()
+
+    def rec(rem: int, chain: Tuple[int, ...]):
+        if rem == 1:
+            if chain:
+                out.add(chain)
+            return
+        if len(chain) == max_levels - 1:
+            out.add(chain + (rem,))
+            return
+        for f in range(2, rem + 1):
+            if rem % f == 0:
+                rec(rem // f, chain + (f,))
+
+    rec(n, ())
+    return sorted(out)
+
+
+def chain_groups(n: int, chain: Tuple[int, ...]):
+    """The per-tier rank groupings of a factorization chain: tier ``l``
+    groups ranks that differ only in the ``l``-th mixed-radix digit —
+    each group has one member per lower-tier block, every member
+    holding its block's partial, so the tiers compose exactly like
+    ``reduce_grouped``'s inner/outer pair (which IS the 2-level
+    member)."""
+    levels = []
+    stride = 1
+    for f in chain:
+        block = stride * f
+        groups = []
+        for c in range(n // block):
+            for o in range(stride):
+                groups.append(tuple(c * block + j * stride + o
+                                    for j in range(f)))
+        levels.append((tuple(groups), f))
+        stride = block
+    return levels
+
+
+def fold_program(n: int, chain: Tuple[int, ...]) -> Program:
+    """The multi-level grouped ordered-fold program of a chain."""
+    if any(f < 2 for f in chain) or _prod(chain) != n:
+        raise CommError(
+            f"factorization chain {chain} does not factor a {n}-rank "
+            "world into tiers of >= 2")
+    steps = tuple(Step("level_fold", (groups, f))
+                  for groups, f in chain_groups(n, chain))
+    return Program("allreduce", "synth", n, (Phase("seq", steps),))
+
+
+def _prod(t) -> int:
+    p = 1
+    for f in t:
+        p *= int(f)
+    return p
+
+
+def synthesize(n: int, nbytes: int, itemsize: int = 4) -> Dict:
+    """Search the bounded family at one ``(nranks, nbytes)`` point.
+    Returns the deterministic report: every candidate's census, the
+    winner (name, program, census), and the ring baseline it is scored
+    against (the DETERMINISTIC ring — the ordered fold, the schedule a
+    synthesized winner would actually replace)."""
+    from .programs import allreduce_program
+    from .. import constants as C
+
+    nelems = max(1, nbytes // itemsize)
+    ring = allreduce_program("ring", n, C.MPI_SUM, deterministic=True,
+                             nelems=nelems, itemsize=itemsize)
+    ring_census = program_census(ring, nelems, itemsize)
+    candidates = []
+    for chain in factorization_chains(n):
+        prog = fold_program(n, chain)
+        cen = program_census(prog, nelems, itemsize)
+        candidates.append((chain, prog, cen))
+    if not candidates:
+        # A 1-rank world has no schedule to synthesize.
+        return {"nranks": n, "nbytes": int(nbytes), "winner": None,
+                "chain": [], "program": None, "census": ring_census,
+                "ring_census": ring_census,
+                "synthesis_beats_ring": False, "candidates": []}
+    # Deterministic ranking: wire bytes, then sequential rounds, then
+    # the digest (content-stable, so ties can never flip across runs).
+    ranked = sorted(
+        candidates,
+        key=lambda c: (c[2]["wire_bytes_per_rank"], c[2]["seq_steps"],
+                       c[1].digest()))
+    chain, prog, cen = ranked[0]
+    name = SYNTH_PREFIX + prog.digest()
+    beats = (cen["wire_bytes_per_rank"]
+             < ring_census["wire_bytes_per_rank"]) or (
+        cen["wire_bytes_per_rank"] == ring_census["wire_bytes_per_rank"]
+        and cen["seq_steps"] < ring_census["seq_steps"])
+    return {
+        "nranks": n,
+        "nbytes": int(nbytes),
+        "winner": name,
+        "chain": list(chain),
+        "program": prog,
+        "census": cen,
+        "ring_census": ring_census,
+        "synthesis_beats_ring": bool(beats),
+        "candidates": [
+            {"chain": list(ch), "wire_bytes_per_rank":
+                c["wire_bytes_per_rank"], "seq_steps": c["seq_steps"]}
+            for ch, _p, c in ranked],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Registry + tune-cache integration
+# ---------------------------------------------------------------------------
+
+
+def install(program: Program) -> str:
+    """Install a synthesized program; returns its cache name."""
+    name = SYNTH_PREFIX + program.digest()
+    _INSTALLED[name] = program
+    return name
+
+
+def installed_program(name: str, nranks: Optional[int] = None) -> Program:
+    prog = _INSTALLED.get(name)
+    if prog is None:
+        raise CommError(
+            f"synthesized schedule {name!r} is not installed in this "
+            "process — run csched.synth.synthesize/autotune_synthesis, "
+            "or let a tune-cache lookup install the persisted winner")
+    if nranks is not None and prog.nranks != nranks:
+        raise CommError(
+            f"synthesized schedule {name!r} was built for "
+            f"{prog.nranks} ranks, not {nranks}")
+    return prog
+
+
+def synth_applicable(name, nranks: int) -> bool:
+    prog = _INSTALLED.get(name)
+    return prog is not None and prog.nranks == nranks
+
+
+def validate_entry(name: str, program_json) -> None:
+    """Tune-cache validation hook for ``synth:`` winners: the entry
+    must carry a program whose digest matches the name; a valid entry
+    installs, so a persisted winner is lowerable right after lookup.
+    Raises ``ValueError`` (the autotuner's stale-entry signal) on any
+    mismatch."""
+    if not isinstance(program_json, dict):
+        raise ValueError(
+            f"synthesized winner {name!r} has no serialized program")
+    try:
+        prog = Program.from_json(program_json)
+    except Exception as e:  # noqa: BLE001 — any defect means "stale"
+        # A corrupt entry — or one written by a NEWER version whose
+        # extended grammar this build does not know (Step/Phase raise
+        # CommError on unknown kinds) — must surface as the autotuner's
+        # stale-entry signal (ValueError, caught by lookup), never
+        # crash deterministic auto-selection.
+        raise ValueError(
+            f"synthesized winner {name!r} carries a program this "
+            f"build cannot load: {e}") from e
+    if SYNTH_PREFIX + prog.digest() != name:
+        raise ValueError(
+            f"synthesized winner {name!r} does not match its program "
+            f"digest {prog.digest()!r}")
+    _INSTALLED[name] = prog
+
+
+def clear_installed() -> None:
+    _INSTALLED.clear()
+
+
+def autotune_synthesis(nranks: Optional[int] = None,
+                       sizes=(1 << 10, 1 << 14, 1 << 18),
+                       dtype=None, persist: bool = True) -> Dict:
+    """The synthesis autotuner leg: search each size bucket, install
+    winners that beat the deterministic ring, and record them under the
+    existing tune cache key (``synth:<digest>`` + the serialized
+    program riding the entry).  Deterministic-mode auto selection then
+    serves them like any measured winner."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import tune as _tune
+
+    if dtype is None:
+        dtype = jnp.float32
+    n = nranks or len(jax.devices())
+    itemsize = jnp.dtype(dtype).itemsize
+    report = {"collective": "allreduce", "nranks": n,
+              "dtype": str(jnp.dtype(dtype)), "entries": {}}
+    for nbytes in sizes:
+        res = synthesize(n, int(nbytes), itemsize)
+        ent = {k: res[k] for k in ("winner", "chain", "census",
+                                   "ring_census",
+                                   "synthesis_beats_ring")}
+        if res["synthesis_beats_ring"] and n > 1:
+            prog = res["program"]
+            install(prog)
+            # The codec key dimension keeps census-synthesized winners
+            # in their own slot: they can never clobber — or be
+            # clobbered by — wall-clock-measured winners of the same
+            # bucket (the same separation compressed traffic uses).
+            _tune.record("allreduce", dtype, int(nbytes), n,
+                         res["winner"], persist=persist, codec="synth",
+                         program=prog.to_json())
+            ent["recorded"] = True
+        report["entries"][str(int(nbytes))] = ent
+    return report

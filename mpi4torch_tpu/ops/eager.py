@@ -144,6 +144,23 @@ def _rendezvous_fold(world_size: int, algorithm,
                 return ring
             raise
         return "torus", lambda op, vals: C.reduce_torus(op, vals, g)
+    if isinstance(algorithm, str) and algorithm.startswith("synth:"):
+        # A synthesized IR schedule (mpi4torch_tpu.csched.synth): the
+        # eager fold is the program's interpretation — the same oracle
+        # Mode A's lowering is pinned against, so synthesized winners
+        # keep the per-algorithm Mode A/B bitwise contract for free.
+        from .. import csched
+        if not csched.synth_applicable(algorithm, world_size):
+            if not explicit:
+                return ring
+            raise CommError(
+                f"synthesized schedule {algorithm!r} is not installed "
+                f"for a {world_size}-rank world (run the synthesis "
+                "autotuner or load its tune-cache entry)")
+        prog = csched.installed_program(algorithm, world_size)
+        return algorithm, (
+            lambda op, vals: csched.interpret_allreduce(prog, op,
+                                                        list(vals)))
     raise CommError(
         f"unknown collective algorithm {algorithm!r} for the eager "
         "backend")

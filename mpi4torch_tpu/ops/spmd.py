@@ -959,59 +959,67 @@ def _torus_allreduce_value(ctx: SpmdContext, x, op: int):
     flat = x.reshape(-1)
     total = flat.size
     m = C.multipath_split(total)
-    h0, h1 = flat[:m], flat[m:]
+    # Channel slices are taken lazily (half 1 only after half 0's
+    # schedule is emitted) — the uniform channel-emission order of the
+    # one IR lowering (csched.lower), shared with the bidir chains.
     if op == C.MPI_SUM and not _config.deterministic_reductions():
-        o0 = _grouped_sum_schedule(h0, g, (axis, inner), (axis, outer),
-                                   (axis, inner))
-        o1 = (_grouped_sum_schedule(h1, ngroups, (axis, outer),
+        o0 = _grouped_sum_schedule(flat[:m], g, (axis, inner),
+                                   (axis, outer), (axis, inner))
+        o1 = (_grouped_sum_schedule(flat[m:], ngroups, (axis, outer),
                                     (axis, inner), (axis, outer))
               if m < total else None)
     else:
-        o0 = _grouped_ordered_fold(h0, op, g, ngroups, (axis, inner),
-                                   (axis, outer))
-        o1 = (_grouped_ordered_fold(h1, op, ngroups, g, (axis, outer),
-                                    (axis, inner))
+        o0 = _grouped_ordered_fold(flat[:m], op, g, ngroups,
+                                   (axis, inner), (axis, outer))
+        o1 = (_grouped_ordered_fold(flat[m:], op, ngroups, g,
+                                    (axis, outer), (axis, inner))
               if m < total else None)
     if o1 is None:
         return o0.reshape(shape)
     return jnp.concatenate([o0, o1]).reshape(shape)
 
 
+def _csched_args(ctx: SpmdContext, x):
+    """Static call data the IR program builder keys on — pure shape/
+    dtype reads, no ops added to the trace."""
+    shape = jnp.shape(x)
+    return (math.prod(shape) if shape else 1,
+            jnp.dtype(jnp.result_type(x)).itemsize)
+
+
 def _allreduce_fwd_value(ctx: SpmdContext, x, op: int,
                          algorithm: str = "ring"):
-    if algorithm == "rhd":
-        return _rhd_allreduce_value(ctx, x, op)
-    if algorithm == "tree":
-        return _tree_allreduce_value(ctx, x, op)
-    if algorithm == "hier":
-        return _hier_allreduce_value(ctx, x, op)
-    if algorithm == "bidir":
-        return _bidir_allreduce_value(ctx, x, op)
-    if algorithm == "torus":
-        return _torus_allreduce_value(ctx, x, op)
-    if op == C.MPI_SUM:
-        if _config.deterministic_reductions():
-            return _ordered_fold_allreduce(ctx, x, op)
-        return lax.psum(x, ctx.axis_name)
-    if op == C.MPI_MAX:
-        return lax.pmax(x, ctx.axis_name)
-    if op == C.MPI_MIN:
-        return lax.pmin(x, ctx.axis_name)
-    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
-        C.combine2(op, x, x)  # raises NotImplementedError with explanation
-    return _ordered_fold_allreduce(ctx, x, op)
+    """ONE dispatch for every allreduce schedule: build the algorithm's
+    IR program (mpi4torch_tpu.csched — the hand-written forms above are
+    its registered per-step emitter bodies and the bit-identity
+    references `make ir-smoke` pins) and lower it at the call site.
+    ``synth:<digest>`` names lower installed synthesized programs the
+    same way."""
+    from .. import csched
 
+    nelems, itemsize = _csched_args(ctx, x)
+    prog = csched.allreduce_program(
+        algorithm, ctx.size, op,
+        deterministic=_config.deterministic_reductions(),
+        nelems=nelems, itemsize=itemsize)
+    return csched.lower_allreduce(prog, ctx, x, op)
 
 
 def _allreduce_bwd_value(ctx: SpmdContext, g, algorithm: str):
-    """The SUM-allreduce adjoint on the matching algorithm.  ``bidir``
-    swaps its halves' ring directions — the adjoint of a ring segment is
-    a ring segment in the reverse direction, so the backward rides the
-    same multipath machinery with swapped channels; every other
-    algorithm's allreduce is self-adjoint as-is."""
-    if algorithm == "bidir":
-        return _bidir_allreduce_value(ctx, g, C.MPI_SUM, reverse=True)
-    return _allreduce_fwd_value(ctx, g, C.MPI_SUM, algorithm)
+    """The SUM-allreduce adjoint: the TRANSPOSED program of the forward
+    (csched.transpose — allreduce programs are self-adjoint with every
+    directional step's ring reversed, so ``bidir``'s halves swap
+    directions and every other schedule re-runs as-is, exactly the
+    hand-written per-algorithm backwards)."""
+    from .. import csched
+
+    nelems, itemsize = _csched_args(ctx, g)
+    prog = csched.allreduce_program(
+        algorithm, ctx.size, C.MPI_SUM,
+        deterministic=_config.deterministic_reductions(),
+        nelems=nelems, itemsize=itemsize)
+    return csched.lower_allreduce(csched.transpose(prog), ctx, g,
+                                  C.MPI_SUM)
 
 
 def _bwd_scope(opname: str):
@@ -1153,27 +1161,35 @@ def _tree_bcast_value(ctx: SpmdContext, x, root: int):
 
 
 def _bcast_value(ctx: SpmdContext, x, root: int, algorithm=None):
+    """Bcast_ through the IR: ``tree`` is the binomial program (whose
+    transpose IS the tree Reduce_ program — the derived-backward pair),
+    ``ring`` the mask+psum pair, ``None`` the size dispatch
+    (config.bcast_tree_max_bytes) — the csched builder mirrors the
+    historical dispatch bit for bit."""
+    from .. import csched
+
     if ctx.size == 1:
         return x
-    if algorithm == "tree":
-        return _tree_bcast_value(ctx, x, root)
-    if algorithm == "ring":
-        return lax.psum(_mask_to_root(ctx, x, root), ctx.axis_name)
-    size_bytes = x.size * x.dtype.itemsize
-    if size_bytes <= _config.bcast_tree_max_bytes():
-        return _tree_bcast_value(ctx, x, root)
-    # Root-masked psum: adding zeros is exact for floats, so this is
-    # value-identical to the tree path for every dtype and root.
-    return lax.psum(_mask_to_root(ctx, x, root), ctx.axis_name)
+    nelems, itemsize = _csched_args(ctx, x)
+    prog = csched.bcast_program(algorithm, ctx.size, root,
+                                nbytes=nelems * itemsize)
+    return csched.lower_value(prog, ctx, x, C.MPI_SUM)
 
 
 def _reduce_value(ctx: SpmdContext, x, op: int, root: int,
                   algorithm=None):
-    if algorithm == "tree":
-        return _tree_reduce_value(ctx, x, op, root)
-    red = _allreduce_fwd_value(ctx, x, op)
-    # Non-root results are zeroed (reference: csrc/extension.cpp:443-447).
-    return _mask_to_root(ctx, red, root)
+    """Reduce_ through the IR: ``tree`` is the binomial reduce program;
+    everything else is the ring allreduce program with a root mask
+    appended (non-root results zeroed, reference:
+    csrc/extension.cpp:443-447)."""
+    from .. import csched
+
+    nelems, itemsize = _csched_args(ctx, x)
+    prog = csched.reduce_program(
+        algorithm, ctx.size, op, root,
+        deterministic=_config.deterministic_reductions(),
+        nelems=nelems, itemsize=itemsize)
+    return csched.lower_value(prog, ctx, x, op)
 
 
 def bcast_(ctx: SpmdContext, x, root: int, algorithm=None):

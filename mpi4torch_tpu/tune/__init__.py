@@ -99,6 +99,27 @@ def resolve_request(requested, *, collective: str = "allreduce",
     spelling overrides an active ``algorithm_scope``)."""
     if requested is None or requested is False or requested == "auto":
         return None
+    if isinstance(requested, str) and requested.startswith("synth:"):
+        # A synthesized IR schedule (csched.synth): serves allreduce
+        # only, and only when its program is installed for THIS world —
+        # the usual degrade/raise rule otherwise.
+        from ..csched import synth as _synth
+
+        if collective != "allreduce":
+            if explicit:
+                raise CommError(
+                    f"synthesized schedule {requested!r} serves "
+                    f"allreduce, not {collective}")
+            return None
+        if _synth.synth_applicable(requested, nranks):
+            return requested
+        if explicit:
+            raise CommError(
+                f"synthesized schedule {requested!r} is not installed "
+                f"for a {nranks}-rank world (run "
+                "csched.synth.autotune_synthesis or load its "
+                "tune-cache entry)")
+        return None
     spec = get_algorithm(requested)  # raises on unknown names
     reason = spec.why_not(nranks, collective)
     if reason is None:
@@ -157,7 +178,24 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     registry's ``codec_capable`` gate (the block-q8 family rides
     ring/bidir/torus, the bf16 family is ring-only) and reads measured
     winners from the cache's codec-keyed dimension."""
-    if nranks <= 1 or deterministic:
+    if nranks <= 1:
+        return "ring"
+    if deterministic:
+        # Deterministic mode pins ring — UNLESS a synthesized IR
+        # schedule (csched.synth — an exact grouped ordered fold, so
+        # deterministic by construction) won this bucket on the census
+        # sweep and its program is installed: the one evidence-backed
+        # deviation, like measured winners in the wall-clock tiers.
+        # Synthesis entries live under their own codec="synth" key
+        # slot, so they never collide with measured winners.
+        from ..csched import synth as _synth
+
+        if codec is None:
+            w = lookup_algorithm(collective, dtype, nbytes, nranks,
+                                 codec="synth")
+            if (_synth.is_synth_name(w)
+                    and _synth.synth_applicable(w, nranks)):
+                return w
         return "ring"
 
     def ok(name: str) -> bool:
@@ -186,6 +224,11 @@ def select_auto(*, collective: str = "allreduce", nbytes: int,
     # never hijack — or be hijacked by — exact selection.
     winner = lookup_algorithm(collective, dtype, nbytes, nranks,
                               codec=codec)
+    if winner is not None and winner.startswith("synth:"):
+        # Synthesized winners are deterministic-census verdicts; they
+        # serve deterministic mode (above) and must not steer the
+        # wall-clock-measured non-deterministic tiers.
+        winner = None
     crossover = _config.latency_crossover_bytes()
     if winner is not None and ok(winner):
         if (codec is None and crossover is not None

@@ -36,10 +36,23 @@ def _load_raw() -> Optional[dict]:
         return None
 
 
+def _program_steps(ent: dict) -> int:
+    """Step count of a synthesized entry's serialized IR program."""
+    try:
+        return sum(len(ph.get("steps", ()))
+                   for ph in ent["program"]["phases"])
+    except (KeyError, TypeError):
+        return 0
+
+
 def _rows(data: dict) -> List[tuple]:
     """Decode ``collective|dtype|bucket|nranks|platform`` keys into table
     rows; malformed entries are skipped, not fatal — this is a debugging
-    surface over a best-effort cache."""
+    surface over a best-effort cache.  Synthesized-program winners
+    (``synth:<digest>`` entries carrying their serialized IR program,
+    mpi4torch_tpu.csched) render distinctly from named algorithms: the
+    digest in the algorithm column, ``synthesized(<n> steps)`` as the
+    source."""
     rows = []
     entries = data.get("entries")
     if not isinstance(entries, dict):
@@ -49,11 +62,26 @@ def _rows(data: dict) -> List[tuple]:
             continue
         parts = key.split("|")
         algo = ent.get("algorithm")
-        if len(parts) != 5 or not isinstance(algo, str):
+        if not isinstance(algo, str):
+            continue
+        if len(parts) == 6 and parts[5].startswith("codec="):
+            # Codec-keyed winners (compressed traffic's own slots, and
+            # codec=synth — the synthesis dimension) render with the
+            # slot tag on the collective column.
+            parts = [parts[0] + "[" + parts[5][len("codec="):] + "]"] \
+                + parts[1:5]
+        if len(parts) != 5:
             continue
         collective, dtype, bucket, nranks, platform = parts
+        if algo.startswith("synth:") and isinstance(ent.get("program"),
+                                                    dict):
+            source = f"synthesized({_program_steps(ent)} steps)"
+        elif ent.get("measurements"):
+            source = "measured"
+        else:
+            source = "recorded"
         rows.append((collective, dtype, bucket, nranks, platform, algo,
-                     "measured" if ent.get("measurements") else "recorded"))
+                     source))
     return rows
 
 

@@ -118,11 +118,21 @@ def make_key(collective: str, dtype, nbytes: int, nranks: int,
     return key
 
 
-def _validate_winner(collective: str, algorithm: str) -> None:
+def _validate_winner(collective: str, algorithm: str,
+                     ent: Optional[dict] = None) -> None:
     """Winner names are validated against the registry that owns them:
-    reshard entries name a planner strategy, everything else a
+    reshard entries name a planner strategy, ``synth:<digest>`` entries
+    a synthesized IR program (the entry must carry the serialized
+    program, which installs on successful validation — so a persisted
+    winner is lowerable right after lookup), everything else a
     collective algorithm.  Raises on unknown names (record) — lookup
     callers catch and ignore stale entries."""
+    if isinstance(algorithm, str) and algorithm.startswith("synth:"):
+        from ..csched import synth as _synth
+
+        _synth.validate_entry(algorithm,
+                              None if ent is None else ent.get("program"))
+        return
     if collective == "reshard":
         from ..reshard.plan import STRATEGIES
 
@@ -256,7 +266,7 @@ def lookup(collective: str, dtype, nbytes: int, nranks: int,
                      help="autotuner cache lookups that found no winner")
         return None
     try:
-        _validate_winner(collective, ent["algorithm"])
+        _validate_winner(collective, ent["algorithm"], ent)
     except (ValueError, KeyError, TypeError):
         _metrics.inc("tune_cache_misses_total")
         return None
@@ -288,16 +298,23 @@ def record(collective: str, dtype, nbytes: int, nranks: int,
            algorithm: str, platform: Optional[str] = None,
            measurements: Optional[dict] = None,
            persist: bool = True, codec=None,
-           transition: Optional[str] = None) -> str:
+           transition: Optional[str] = None,
+           program: Optional[dict] = None) -> str:
     """Store a winner for a key (and persist).  Bumps the selection
     generation so ``run_spmd`` jit cache keys see the change and
-    retrace instead of reusing a lowering picked under the old table."""
+    retrace instead of reusing a lowering picked under the old table.
+    ``program`` carries a synthesized winner's serialized IR program
+    (mpi4torch_tpu.csched) — required for ``synth:<digest>`` names, so
+    a later process can re-install and lower the schedule straight from
+    the cache entry."""
     global _generation
     _load()
-    _validate_winner(collective, algorithm)
     key = make_key(collective, dtype, nbytes, nranks, platform,
                    codec=codec, transition=transition)
     ent = {"algorithm": algorithm, "measured_at": time.time()}
+    if program is not None:
+        ent["program"] = program
+    _validate_winner(collective, algorithm, ent)
     name = _codec_name(codec)
     if name is not None:
         ent["codec"] = str(name)
