@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -75,6 +75,22 @@ faults-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.resilience --smoke
+
+# CPU smoke run of the GRAY-failure chaos matrix
+# (mpi4torch_tpu.resilience.chaos, ISSUE 15): every performance-fault
+# kind — slow_rank, jitter, flaky_link, brownout — composed with every
+# subsystem (plain / fused / compressed / overlap / serve / elastic)
+# plus seeded multi-fault storms.  Every cell must end
+# recovered-BITWISE, degraded-with-attributed-report (detector names
+# the slow rank, the degrade policy applies through an epoch-fenced
+# consensus so ALL ranks switch schedules in lock-step), or in its
+# typed attributed raise (SlowRankError + flight-recorder postmortem)
+# — never a hang; the fired-fault ledger must show every gray kind
+# acted, and the degrade-policy registry-sync guard runs first.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.resilience --chaos
 
 # CPU smoke run of the resharding subsystem (mpi4torch_tpu.reshard):
 # every representative (mesh, spec)->(mesh', spec') transition — the

@@ -30,6 +30,7 @@ __all__ = [
     "set_drift",
     "resilience_problems",
     "elastic_problems",
+    "degrade_problems",
     "reshard_step_problems",
     "serve_policy_problems",
     "tune_problems",
@@ -126,6 +127,64 @@ def elastic_problems() -> List[str]:
            if v not in ("recover", "raise")]
     if bad:
         problems.append(f"unknown elastic cell outcomes {sorted(set(bad))}")
+    return problems
+
+
+# ---------------------------------------------------------------- degrade
+
+def degrade_problems() -> List[str]:
+    """Gray-failure registry sync (ISSUE 15): the chaos matrix's
+    coverage table vs the gray fault kinds (each of which must also be
+    a registered fault kind WITH a plain fault-matrix row — the
+    resilience matrix pins the transient behavior before the chaos
+    matrix composes detection/degrade on top), and the degrade-policy
+    registry vs the chaos matrix's degrade cells — a policy without a
+    cell, or a covered cell whose policy is unregistered, fails
+    ``make chaos-smoke`` AND ``make analyze-smoke``."""
+    from ..resilience.chaos import (CHAOS_COVERAGE, CHAOS_SUBSYSTEMS,
+                                    DEGRADE_COVERED, GRAY_KINDS)
+    from ..resilience.degrade import DEGRADE_POLICIES
+    from ..resilience.faults import FAULT_KINDS
+    from ..resilience.matrix import COVERAGE as FAULT_COVERAGE
+
+    problems = set_drift(
+        GRAY_KINDS, CHAOS_COVERAGE,
+        "gray-kind/chaos-coverage drift: kinds={registered} "
+        "covered={covered} — every gray kind needs a chaos row and "
+        "vice versa")
+    for kind in GRAY_KINDS:
+        if kind not in FAULT_KINDS:
+            problems.append(
+                f"gray kind {kind!r} is not a registered fault kind — "
+                "register it (resilience.faults) so the injection "
+                "grammar covers it")
+        elif kind not in FAULT_COVERAGE:
+            problems.append(
+                f"gray kind {kind!r} has no plain fault-matrix row — "
+                "the resilience matrix must pin its transient behavior "
+                "before the chaos matrix composes the gray one")
+        missing = set(CHAOS_SUBSYSTEMS) - set(CHAOS_COVERAGE.get(kind,
+                                                                 {}))
+        if missing:
+            problems.append(f"{kind}: no chaos cell for subsystem(s) "
+                            f"{sorted(missing)}")
+    problems += set_drift(
+        DEGRADE_POLICIES, set(DEGRADE_COVERED.values()),
+        "degrade-policy registry {registered} != chaos-covered "
+        "policies {covered} — every registered policy needs a degrade "
+        "cell exercising it (DEGRADE_COVERED) and vice versa")
+    for (kind, subsystem), policy in DEGRADE_COVERED.items():
+        if CHAOS_COVERAGE.get(kind, {}).get(subsystem) != "degrade":
+            problems.append(
+                f"DEGRADE_COVERED names ({kind} x {subsystem}) for "
+                f"policy {policy!r}, but the chaos coverage table does "
+                "not declare that cell 'degrade'")
+    bad = sorted({v for rows in CHAOS_COVERAGE.values()
+                  for v in rows.values()
+                  if v not in ("recover", "degrade", "escalate",
+                               "inert")})
+    if bad:
+        problems.append(f"unknown chaos cell outcomes {bad}")
     return problems
 
 
@@ -285,6 +344,7 @@ def standing_problems() -> List[str]:
     ``make analyze-smoke`` lane too."""
     problems = [f"resilience: {p}" for p in resilience_problems()]
     problems += [f"elastic: {p}" for p in elastic_problems()]
+    problems += [f"degrade: {p}" for p in degrade_problems()]
     problems += [f"reshard: {p}" for p in reshard_step_problems()]
     problems += [f"csched: {p}" for p in csched_problems()]
     from ..serve.__main__ import PARITY_POLICIES

@@ -42,7 +42,7 @@ Execution conventions (who runs the plan):
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -178,6 +178,16 @@ class ServeTicket:
     emitted: List[int] = field(default_factory=list)
     max_new: int = 0
     key: Any = None
+    # REMAINING deadline budget in seconds at drain time (ISSUE 15),
+    # None = no deadline.  Carried as a relative duration, not an
+    # absolute instant: the destination engine's clock is a different
+    # clock domain whenever either engine injects one (the fake-clock
+    # tests, the chaos matrix), and mixing domains would wrongly expire
+    # — or wrongly resurrect — the request.  The re-admitted request
+    # keeps this remaining budget; a ticket whose budget was consumed
+    # by resize downtime is surfaced as ``deadline_expired`` at
+    # re-admission (see :func:`readmit`), never silently dropped.
+    deadline_s: Optional[float] = None
 
     @property
     def remaining(self) -> int:
@@ -205,9 +215,16 @@ def drain_tickets(engine, *, snapshot: bool = False
     needs."""
     reqs = engine.snapshot_inflight() if snapshot \
         else engine.drain()
+    # Deadlines convert absolute -> remaining HERE, on the draining
+    # engine's own clock (the only clock the absolute instant is
+    # meaningful on); the ticket then carries a plain duration any
+    # destination engine can re-anchor.
+    now = engine._clock()
     tickets = [ServeTicket(rid=r["rid"], prompt=r["prompt"],
                            emitted=list(r["emitted"]),
-                           max_new=r["max_new"], key=r["key"])
+                           max_new=r["max_new"], key=r["key"],
+                           deadline_s=(None if r.get("deadline") is None
+                                       else r["deadline"] - now))
                for r in reqs]
     return tickets, engine.results()
 
@@ -215,14 +232,23 @@ def drain_tickets(engine, *, snapshot: bool = False
 def readmit(engine, tickets) -> List[Any]:
     """Re-admit drained tickets through the engine's ordinary admission
     path (the registered POLICIES pick the order, exactly like fresh
-    traffic).  Already-finished tickets are skipped; returns the rids
-    actually re-submitted."""
+    traffic).  Already-finished tickets are skipped; a ticket whose
+    remaining deadline budget is gone (consumed by resize downtime) is
+    recorded on the engine as a typed ``deadline_expired`` result
+    carrying the oracle-prefix tokens it had earned
+    (:meth:`Engine.admit_expired` — never silently dropped, never
+    burns a prefill).  Returns the rids actually re-submitted for
+    decoding."""
     out = []
     for t in tickets:
         if t.remaining <= 0:
             continue
+        if t.deadline_s is not None and t.deadline_s <= 0:
+            engine.admit_expired(t.extended_prompt(), rid=t.rid)
+            continue
         engine.submit(t.extended_prompt(), rid=t.rid,
-                      max_new=t.remaining, key=t.key)
+                      max_new=t.remaining, key=t.key,
+                      deadline_s=t.deadline_s)
         out.append(t.rid)
     return out
 

@@ -166,6 +166,12 @@ class CommEvent:
     signature: Tuple = ()
     payload_bytes: int = 0
     duration_s: float = 0.0
+    # Time this rank spent BLOCKED on peers at the rendezvous barrier —
+    # duration_s minus wait_s is the rank's own pre-barrier (local)
+    # latency, the gray-failure detector's attribution signal
+    # (mpi4torch_tpu.resilience.health): a slow rank shows high local
+    # time and near-zero wait while every peer shows the inverse.
+    wait_s: float = 0.0
     t_start: float = 0.0
     retries: int = 0
     status: str = "ok"
@@ -187,9 +193,9 @@ class CommEvent:
         recorder dump and the Chrome-trace exporter)."""
         d = {k: getattr(self, k) for k in (
             "seq", "rank", "world", "world_size", "channel", "op",
-            "payload_bytes", "duration_s", "t_start", "retries",
-            "status", "family", "bookkeeping", "algorithm", "codec",
-            "bucket", "group_size", "peer", "tag")}
+            "payload_bytes", "duration_s", "wait_s", "t_start",
+            "retries", "status", "family", "bookkeeping", "algorithm",
+            "codec", "bucket", "group_size", "peer", "tag")}
         d["signature"] = repr(self.signature)
         if self.shape is not None:
             d["shape"] = list(self.shape)

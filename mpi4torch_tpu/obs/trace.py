@@ -59,14 +59,18 @@ def _failure_types():
     global _FAILURE_TYPES
     if _FAILURE_TYPES is None:
         from ..elastic.membership import ConsensusError
+        from ..resilience.health import SlowRankError
         from ..runtime import (DeadlockError, IntegrityError,
                                RankFailedError)
         # ConsensusError rides the same reaper entry point every other
         # attributed failure does (run_ranks routes rank failures to
         # note_rank_failure) — a failed resize gets its flight-recorder
-        # postmortem with zero new hooks.
+        # postmortem with zero new hooks.  SlowRankError (ISSUE 15)
+        # joins the set the same way: a gray-failure escalation raised
+        # inside a rank body snapshots a postmortem through the reaper,
+        # and a driver-side escalation calls note_gray_failure directly.
         _FAILURE_TYPES = (RankFailedError, DeadlockError, IntegrityError,
-                          ConsensusError)
+                          ConsensusError, SlowRankError)
     return _FAILURE_TYPES
 
 
@@ -122,7 +126,7 @@ class _Meter:
 
     __slots__ = ("tracer", "world_ord", "world_size", "rank", "channel",
                  "signature", "payload_bytes", "peer", "tag", "t0",
-                 "retries", "bucket")
+                 "retries", "bucket", "wait_s")
 
     def __init__(self, tracer, world_ord, world_size, rank, channel,
                  signature, payload_bytes, peer, tag):
@@ -137,10 +141,17 @@ class _Meter:
         self.tag = tag
         self.bucket = current_label()
         self.retries = 0
+        self.wait_s = 0.0
         self.t0 = time.perf_counter()
 
     def add_retries(self, n: int) -> None:
         self.retries += n
+
+    def add_wait(self, seconds: float) -> None:
+        """Barrier-blocked time the runtime reports (both rendezvous
+        barriers of an exchange add in) — the gray-failure detector's
+        local-vs-wait split (resilience.health)."""
+        self.wait_s += seconds
 
 
 class CommTracer:
@@ -213,7 +224,7 @@ class CommTracer:
             op=ann["op"], signature=(meter.signature if isinstance(
                 meter.signature, tuple) else (meter.signature,)),
             payload_bytes=meter.payload_bytes, duration_s=dur,
-            t_start=meter.t0, retries=meter.retries,
+            wait_s=meter.wait_s, t_start=meter.t0, retries=meter.retries,
             status="ok" if error is None else type(error).__name__,
             family=ann.get("family"), bookkeeping=ann["bookkeeping"],
             unmodeled=ann.get("unmodeled", False),
@@ -278,6 +289,23 @@ class CommTracer:
             seq=next(self._seq), rank=rank,
             world=self._world_ord(world), world_size=world.size,
             channel="exchange", op=f"({type(error).__name__})",
+            status=type(error).__name__)
+        self._note_failure(ev, error)
+
+    def note_gray_failure(self, world_ord: int, world_size: int,
+                          rank: int, error: BaseException) -> None:
+        """Postmortem entry point for DRIVER-side gray-failure
+        escalations (mpi4torch_tpu.resilience.health): the detector
+        runs between phases, outside any rank body, so there is no
+        world object and no reaper — it names the traced world by the
+        ordinal its events carry.  Same dedup/snapshot semantics as
+        :meth:`note_rank_failure`."""
+        if not isinstance(error, _failure_types()):
+            return
+        ev = CommEvent(
+            seq=next(self._seq), rank=rank, world=world_ord,
+            world_size=world_size, channel="exchange",
+            op=f"({type(error).__name__})",
             status=type(error).__name__)
         self._note_failure(ev, error)
 

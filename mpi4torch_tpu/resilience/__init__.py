@@ -28,8 +28,19 @@ steady state.  This package makes the framework's failures:
   :func:`restore_or_init` (:mod:`.recovery`) survives mid-save kills by
   falling back to the last complete checkpoint step.
 
+* **gray-failure aware** (ISSUE 15) — performance-fault kinds
+  (``slow_rank``/``jitter``/``flaky_link``/``brownout``) inject the
+  failures that never raise; a detector (:mod:`.health`) attributes
+  the slow rank off the CommEvent ``duration − wait`` split (typed
+  :class:`SlowRankReport`, escalating to :class:`SlowRankError` with a
+  flight-recorder postmortem); and the degraded-mode runtime
+  (:mod:`.degrade`) adapts — codec escalation, per-rank-wire-census
+  schedule failover, hot-spare demotion — every transition ratified
+  through epoch-fenced elastic consensus so all ranks switch in
+  lock-step (the chaos matrix, :mod:`.chaos` / ``make chaos-smoke``).
+
 See ``doc/resilience.md`` for the fault-plan grammar, the knob table,
-and the recovery recipe.
+the gray-failure section, and the recovery recipe.
 """
 
 from __future__ import annotations
@@ -39,9 +50,14 @@ from ..runtime import (DeadlockError, HealthReport, IntegrityError,
 from .faults import (FAULT_KINDS, FaultKind, FaultPlan, FaultSpec,
                      as_plan, fault_scope, pending_preemptions,
                      register_fault_kind)
+from .degrade import (DEGRADE_POLICIES, DegradeController, DegradeError,
+                      DegradeTransition, failover_schedule,
+                      rank_wire_bytes, register_degrade_policy)
 from .guards import (IntegrityWarning, check_contributions,
                      clear_violations, last_violation, spmd_finite_value,
                      verify_wire, wire_checksum)
+from .health import (GrayFailureDetector, RankCommStats, SlowRankError,
+                     SlowRankReport, detect_slow_ranks)
 from .recovery import RestoreResult, SkippedStep, restore_or_init
 
 __all__ = [
@@ -60,6 +76,18 @@ __all__ = [
     "verify_wire",
     "last_violation",
     "clear_violations",
+    "GrayFailureDetector",
+    "RankCommStats",
+    "SlowRankError",
+    "SlowRankReport",
+    "detect_slow_ranks",
+    "DEGRADE_POLICIES",
+    "DegradeController",
+    "DegradeError",
+    "DegradeTransition",
+    "failover_schedule",
+    "rank_wire_bytes",
+    "register_degrade_policy",
     "restore_or_init",
     "RestoreResult",
     "SkippedStep",

@@ -1,4 +1,5 @@
-"""`python -m mpi4torch_tpu.resilience --smoke` — the faults-smoke lane.
+"""`python -m mpi4torch_tpu.resilience --smoke|--chaos` — the
+faults-smoke and chaos-smoke lanes.
 
 Runs the FULL fault matrix (:mod:`.matrix`): every registered fault
 kind × one representative collective per subsystem (plain / fused /
@@ -12,8 +13,16 @@ if the fault-kind registry and the coverage table have drifted apart
 (the PR 4/6 registry-sync guard, enforced structurally here and in
 tests/test_resilience.py).
 
-The Makefile's ``faults-smoke`` target runs it on the 8-virtual-device
-CPU harness.
+``--chaos`` runs the GRAY-failure matrix instead (:mod:`.chaos`,
+``make chaos-smoke``): every (gray kind × {plain, fused, compressed,
+overlap, serve, elastic}) cell plus seeded multi-fault storms — each
+cell must end recovered-BITWISE, degraded-with-attributed-report
+(epoch-fenced lock-step transition), or in its typed attributed raise,
+NEVER a hang; the fired-fault ledger must show every gray kind acted
+somewhere, and the degrade-policy registry guard runs first.
+
+The Makefile's ``faults-smoke``/``chaos-smoke`` targets run these on
+the 8-virtual-device CPU harness.
 """
 
 from __future__ import annotations
@@ -93,7 +102,63 @@ def _smoke() -> int:
     return 0
 
 
+def _chaos() -> int:
+    import jax
+
+    from ..analyze.registry import degrade_problems
+    from .chaos import GRAY_KINDS, coverage_cells, run_chaos_cell, \
+        run_storm
+
+    ndev = len(jax.devices())
+    print(f"chaos-smoke: {ndev} device(s), platform "
+          f"{jax.devices()[0].platform}, gray kinds {GRAY_KINDS}")
+
+    problems = degrade_problems()
+    for p in problems:
+        print(f"FAIL[registry]: {p}")
+    failures = len(problems)
+
+    ran = 0
+    fired_kinds = set()
+    for kind, subsystem in coverage_cells():
+        rec = run_chaos_cell(kind, subsystem)
+        ran += 1
+        fired_kinds.update(rec.get("fired", []))
+        tag = f"{kind} x {subsystem} [{rec['expected']}]"
+        if rec["status"] == "ok":
+            print(f"ok  : {tag}: {rec['detail']}")
+        else:
+            failures += 1
+            print(f"FAIL: {tag}: {rec['detail']}")
+
+    for seed in (1, 2):
+        rec = run_storm(seed)
+        ran += 1
+        fired_kinds.update(rec.get("fired", []))
+        if rec["status"] == "ok":
+            print(f"ok  : storm seed={seed}: {rec['detail']}")
+        else:
+            failures += 1
+            print(f"FAIL: storm seed={seed}: {rec['detail']}")
+
+    unacted = set(GRAY_KINDS) - fired_kinds
+    if unacted:
+        failures += 1
+        print(f"FAIL[ledger]: gray kind(s) {sorted(unacted)} never "
+              "fired anywhere — the matrix is vacuous for them")
+
+    print(f"chaos-smoke: {ran} cells, {failures} failure(s)")
+    if failures:
+        return 1
+    print("chaos-smoke: OK — every gray cell recovered bitwise, "
+          "degraded with an attributed epoch-fenced transition, or "
+          "raised typed+attributed; no hangs, every kind acted")
+    return 0
+
+
 def main(argv) -> int:
+    if "--chaos" in argv:
+        return _chaos()
     if "--smoke" in argv:
         return _smoke()
     print(__doc__)

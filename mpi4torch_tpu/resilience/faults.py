@@ -148,13 +148,57 @@ register_fault_kind(FaultKind(
         "largest file is truncated): resilience.restore_or_init falls "
         "back to the last complete step"))
 
+# ------------------------------------------------------ gray failures
+# Performance faults (ISSUE 15): nothing dies, nothing corrupts — the
+# job just gets slow.  Same two chokepoints, same plan grammar, so the
+# gray matrix (resilience/chaos.py, `make chaos-smoke`) composes them
+# with every subsystem for free; detection rides the obs CommEvent
+# stream (resilience/health.py) instead of an error type.
+
+register_fault_kind(FaultKind(
+    "slow_rank", frozenset({"exchange", "p2p"}), transient=True,
+    doc="a chronically slow rank: every matching chokepoint call on the "
+        "rank is delayed by `seconds` (use count>1 for persistence — "
+        "the canonical gray failure).  Recovered within "
+        "config.comm_retries backoff like `delay`; DETECTED by the "
+        "gray-failure detector (resilience.health) as the rank whose "
+        "pre-barrier local latency dominates while its barrier wait "
+        "stays near zero — everyone waits on it, it waits on no one"))
+register_fault_kind(FaultKind(
+    "jitter", frozenset({"exchange", "p2p"}), transient=True,
+    doc="noisy-neighbor latency jitter: each matching call sleeps a "
+        "seeded-deterministic duration in [0, `seconds`) (FNV-hashed "
+        "from (seed, rank, call index) — reproducible storms).  "
+        "Recovered under retries; raises the rank's latency variance "
+        "without the persistent signature of slow_rank"))
+register_fault_kind(FaultKind(
+    "flaky_link", frozenset({"p2p"}), transient=True,
+    doc="a lossy-but-alive link: each matching p2p send is dropped with "
+        "seeded-deterministic probability `p` (the hash discipline of "
+        "jitter), recovered through the SAME redelivery path as "
+        "drop_p2p (stash + NACK-retransmission on recv retry).  Off "
+        "the p2p wire it is inert — the exchange rendezvous has no "
+        "per-link messages to lose"))
+register_fault_kind(FaultKind(
+    "brownout", frozenset({"exchange", "p2p"}), transient=True,
+    doc="a browned-out link: each matching call is throttled "
+        "proportionally to its CENSUSED payload bytes "
+        "(`per_byte_s` x obs.events.payload_nbytes) — so compressed "
+        "traffic PROVABLY suffers less (a q8 wire carries ~1/4 the "
+        "bytes and sleeps ~1/4 as long; the fired-fault ledger records "
+        "bytes and sleep per firing).  The degrade policy it motivates "
+        "is codec escalation (resilience.degrade)"))
+
 
 @dataclass(frozen=True)
 class FaultSpec:
     """One planned fault: WHAT (``kind``), WHERE (``rank`` × ``op``),
     WHEN (``index``/``count`` among that rank's matching calls), plus
-    kind-specific parameters (``seconds`` for ``delay``, ``nflips`` for
-    ``bitflip``)."""
+    kind-specific parameters: ``seconds`` (``delay``/``slow_rank`` per
+    call; ``jitter`` maximum), ``nflips`` (``bitflip``), ``p``
+    (``flaky_link`` drop probability), ``per_byte_s`` (``brownout``
+    throttle per censused payload byte), ``seed`` (the deterministic
+    jitter/flaky hash salt)."""
     kind: str
     rank: Optional[int] = None
     op: Optional[str] = None
@@ -162,6 +206,9 @@ class FaultSpec:
     count: int = 1
     seconds: float = 0.25
     nflips: int = 1
+    p: float = 1.0
+    per_byte_s: float = 1e-3
+    seed: int = 0
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -170,15 +217,24 @@ class FaultSpec:
                 f"{sorted(FAULT_KINDS)}")
         if self.index < 0 or self.count < 1:
             raise ValueError("FaultSpec needs index >= 0 and count >= 1")
+        if not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"FaultSpec p must be in [0, 1], got {self.p}")
+        if self.per_byte_s < 0:
+            raise ValueError(
+                f"FaultSpec per_byte_s must be >= 0, got {self.per_byte_s}")
 
 
 @dataclass
 class FiredFault:
-    """Ledger entry: a fault that actually acted on a payload/rank."""
+    """Ledger entry: a fault that actually acted on a payload/rank.
+    ``info`` carries kind-specific firing evidence (the brownout
+    entry's censused ``bytes``/``sleep_s`` — what the chaos matrix's
+    q8-suffers-less verdict reads)."""
     kind: str
     rank: int
     op: str
     site: str
+    info: Optional[dict] = None
 
 
 class FaultPlan:
@@ -230,9 +286,10 @@ class FaultPlan:
         with self._lock:
             self._counts[(spec_idx, rank)] -= 1
 
-    def _note(self, spec: FaultSpec, rank: int, op: str, site: str):
+    def _note(self, spec: FaultSpec, rank: int, op: str, site: str,
+              info: Optional[dict] = None):
         with self._lock:
-            self.fired.append(FiredFault(spec.kind, rank, op, site))
+            self.fired.append(FiredFault(spec.kind, rank, op, site, info))
 
     def fired_kinds(self) -> FrozenSet[str]:
         with self._lock:
@@ -292,11 +349,21 @@ class FaultPlan:
         Every matched spec fires even when one of them is a drop — the
         drop is applied LAST, so a co-matched delay/corruption is not
         silently swallowed with its index window already consumed (and
-        behavior does not depend on spec order)."""
+        behavior does not depend on spec order).  ``flaky_link`` is the
+        probabilistic drop: it consumes its index window on every
+        matching call (the link IS flaky whether or not this message
+        drops) but only fires — and drops — when its seeded hash says
+        so."""
         drop_spec = None
         for i, spec in self._matching("p2p", src, "p2p"):
             if spec.kind == "drop_p2p":
                 drop_spec = spec
+                continue
+            if spec.kind == "flaky_link":
+                with self._lock:
+                    seen = self._counts[(i, src)] - 1
+                if _hash01(spec.seed, src, seen) < spec.p:
+                    drop_spec = spec
                 continue
             payload = self._fire(i, spec, world, src, "p2p", "p2p",
                                  payload)
@@ -325,6 +392,32 @@ class FaultPlan:
         if spec.kind == "delay":
             self._note(spec, rank, op, site)
             time.sleep(spec.seconds)
+            return payload
+        if spec.kind == "slow_rank":
+            # The persistent gray failure: a fixed per-call tax on every
+            # matching chokepoint call of the rank.
+            self._note(spec, rank, op, site,
+                       info={"sleep_s": spec.seconds})
+            time.sleep(spec.seconds)
+            return payload
+        if spec.kind == "jitter":
+            with self._lock:
+                seen = self._counts[(spec_idx, rank)] - 1
+            pause = spec.seconds * _hash01(spec.seed, rank, seen)
+            self._note(spec, rank, op, site, info={"sleep_s": pause})
+            time.sleep(pause)
+            return payload
+        if spec.kind == "brownout":
+            # Throttle proportional to the CENSUSED payload bytes (the
+            # obs byte census — encoded bytes on a compressed wire), so
+            # a codec escalation provably shortens the stall.
+            from ..obs.events import payload_nbytes
+
+            nbytes = payload_nbytes(payload)
+            pause = spec.per_byte_s * nbytes
+            self._note(spec, rank, op, site,
+                       info={"bytes": nbytes, "sleep_s": pause})
+            time.sleep(pause)
             return payload
         if spec.kind == "rank_death":
             self._note(spec, rank, op, site)
@@ -381,6 +474,20 @@ class FaultPlan:
 
 
 # ---------------------------------------------------------------- mutation
+
+def _hash01(seed: int, rank: int, idx: int) -> float:
+    """Deterministic uniform-ish draw in [0, 1) from (seed, rank, call
+    index) — FNV-1a over the triple, so jitter magnitudes and flaky-link
+    drops replay bit-for-bit under the same plan (seeded storms)."""
+    h = 0x811C9DC5
+    for part in (seed, rank, idx):
+        for ch in str(int(part)).encode():
+            h ^= ch
+            h = (h * 0x01000193) & 0xFFFFFFFF
+        h ^= 0x7C
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return (h & 0xFFFFFF) / float(1 << 24)
+
 
 def _is_float_leaf(leaf) -> bool:
     import jax.numpy as jnp

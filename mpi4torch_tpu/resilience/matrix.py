@@ -66,6 +66,21 @@ COVERAGE: Dict[str, Dict[str, str]] = {
     "preempt": {"plain": "raise", "fused": "raise",
                 "compressed": "raise", "overlap": "raise"},
     "truncate_save": {"checkpoint": "recover"},
+    # Gray (performance) kinds — ISSUE 15.  In THIS matrix they are
+    # transients: recovered bitwise under retries, or provably inert
+    # where they have no eligible wire (flaky_link off the p2p
+    # mailboxes).  Their detection/degrade behavior — the slow-rank
+    # report, codec escalation, schedule failover, epoch-fenced
+    # lock-step transitions — is the chaos matrix's territory
+    # (resilience/chaos.py, `make chaos-smoke`).
+    "slow_rank": {"plain": "recover", "fused": "recover",
+                  "compressed": "recover", "overlap": "recover"},
+    "jitter": {"plain": "recover", "fused": "recover",
+               "compressed": "recover", "overlap": "recover"},
+    "flaky_link": {"plain": "inert", "fused": "inert",
+                   "compressed": "inert", "overlap": "recover"},
+    "brownout": {"plain": "recover", "fused": "recover",
+                 "compressed": "recover", "overlap": "recover"},
 }
 
 EXPECTED_ERROR = {
@@ -76,6 +91,11 @@ EXPECTED_ERROR = {
     "bitflip": IntegrityError,
     "delay": DeadlockError,        # the UNrecovered form (retries=0)
     "drop_p2p": DeadlockError,     # the UNrecovered form
+    # Gray kinds, unrecovered: patience runs out exactly like delay.
+    "slow_rank": DeadlockError,
+    "jitter": DeadlockError,
+    "flaky_link": DeadlockError,
+    "brownout": DeadlockError,
 }
 
 # The matrix worlds: flat 3, flat 8, and 8 as the (2,4) virtual torus.
@@ -88,6 +108,32 @@ CELL_TIMEOUT_S = 0.3
 DELAY_S = 0.5
 RETRIES = 5
 BACKOFF_S = 0.15
+# Gray-kind cell parameters: every sleep beats (or can beat) the 0.3s
+# base window so the retry machinery is really exercised, while the
+# retry patience (0.3 + 0.15 + 0.3 + 0.6 + 1.2 + 2.4s) bounds the cell.
+GRAY_SLOW_S = 0.35        # slow_rank per-call tax
+GRAY_JITTER_S = 0.4       # jitter maximum
+GRAY_PER_BYTE_S = 2e-3    # brownout: 256 B plain payload -> ~0.5s
+GRAY_COUNT = 3            # persistence window of the gray kinds
+
+
+def _spec_for(kind: str, target: int, op_prefix: Optional[str]
+              ) -> FaultSpec:
+    """The per-kind cell spec: gray kinds carry their own parameters
+    and a persistence window; classic kinds keep the historical
+    single-shot DELAY_S shape."""
+    if kind == "slow_rank":
+        return FaultSpec(kind, rank=target, op=op_prefix,
+                         seconds=GRAY_SLOW_S, count=GRAY_COUNT)
+    if kind == "jitter":
+        return FaultSpec(kind, rank=target, op=op_prefix,
+                         seconds=GRAY_JITTER_S, count=GRAY_COUNT)
+    if kind == "brownout":
+        return FaultSpec(kind, rank=target, op=op_prefix,
+                         per_byte_s=GRAY_PER_BYTE_S, count=GRAY_COUNT)
+    if kind == "flaky_link":
+        return FaultSpec(kind, rank=target, op=op_prefix, p=1.0, count=2)
+    return FaultSpec(kind, rank=target, op=op_prefix, seconds=DELAY_S)
 
 
 def _cell_fn(subsystem: str, kind: str, algorithm: Optional[str]):
@@ -141,7 +187,7 @@ def _cell_fn(subsystem: str, kind: str, algorithm: Optional[str]):
         # The eager overlap pipeline's comm entry points are the
         # Isend/Irecv mailboxes: target the p2p site (op=None would also
         # match, but the explicit token documents the wire).
-        return fn, "p2p" if kind in ("drop_p2p",) else None
+        return fn, "p2p" if kind in ("drop_p2p", "flaky_link") else None
 
     raise ValueError(f"unknown matrix subsystem {subsystem!r}")
 
@@ -223,7 +269,7 @@ def run_cell(kind: str, subsystem: str, nranks: int = 3,
     fn, op_prefix = _cell_fn(subsystem, kind, algorithm)
     baseline = _baseline(subsystem, kind, nranks, algorithm)
 
-    spec = FaultSpec(kind, rank=target, op=op_prefix, seconds=DELAY_S)
+    spec = _spec_for(kind, target, op_prefix)
     knobs = {}
     if expected == "recover":
         knobs.update(comm_retries=RETRIES, comm_backoff=BACKOFF_S)

@@ -137,33 +137,54 @@ class HealthReport:
     ``probe_duration_s`` is this caller's wall time inside the probe —
     a failed probe burns its timeout, a healthy one returns in
     microseconds, and the elastic consensus (mpi4torch_tpu.elastic)
-    budgets its rounds off exactly that difference."""
+    budgets its rounds off exactly that difference.
+
+    ``arrival_s`` maps each ARRIVED rank to its arrival latency in
+    seconds relative to the probe round's first arrival (ISSUE 15):
+    a chronically slow rank shows up here with a large offset instead
+    of being indistinguishable from a healthy one — and distinguishable
+    from a DEAD one, which lands in ``missing`` with no entry at all.
+    :meth:`slow_ranks` applies a threshold."""
     ok: bool
     size: int
     arrived: FrozenSet[int]
     missing: FrozenSet[int]
     probe_duration_s: float = 0.0
+    arrival_s: Optional[Dict[int, float]] = None
 
     def __bool__(self) -> bool:
         return self.ok
 
+    def slow_ranks(self, threshold_s: float) -> FrozenSet[int]:
+        """Arrived ranks whose arrival latency (behind the round's
+        first arrival) is at least ``threshold_s`` — slow but ALIVE,
+        the gray counterpart of ``missing``."""
+        if not self.arrival_s:
+            return frozenset()
+        return frozenset(r for r, dt in self.arrival_s.items()
+                         if dt >= threshold_s)
+
 
 class _BarrierTimeout(Exception):
     """Internal: this thread's attributed-barrier wait expired.  Carries
-    the arrival snapshot of the broken generation."""
+    the arrival snapshot of the broken generation (and the per-rank
+    arrival timestamps, for slow-vs-dead attribution)."""
 
-    def __init__(self, arrived: FrozenSet[int]):
+    def __init__(self, arrived: FrozenSet[int], arrive_t=None):
         super().__init__("barrier timeout")
         self.arrived = arrived
+        self.arrive_t = dict(arrive_t or {})
 
 
 class _BarrierBroken(Exception):
     """Internal: the attributed barrier was broken by another thread
     (a peer's timeout, or ``abort()`` after a rank failure)."""
 
-    def __init__(self, arrived: Optional[FrozenSet[int]] = None):
+    def __init__(self, arrived: Optional[FrozenSet[int]] = None,
+                 arrive_t=None):
         super().__init__("barrier broken")
         self.arrived = arrived
+        self.arrive_t = dict(arrive_t or {})
 
 
 # Ceiling on one exponential-backoff pause (config.comm_backoff doubles
@@ -208,22 +229,35 @@ class _AttributedBarrier:
         self._gen = 0
         self._count = 0
         self._arrived: set = set()
+        # Per-rank arrival timestamps of the CURRENT round (ISSUE 15:
+        # slow-vs-dead attribution — a slow rank arrives late, a dead
+        # one never does), snapshotted into _last_arrivals when a round
+        # completes and into timeout_arrive_t when one breaks.
+        self._arrive_t: Dict[int, float] = {}
+        self._last_arrivals: Dict[int, float] = {}
         self._broken = False
         # Arrival snapshot of the generation a timeout broke — lets the
         # *other* waiters of that generation attribute the failure too.
         self.timeout_arrived: Optional[FrozenSet[int]] = None
+        self.timeout_arrive_t: Dict[int, float] = {}
 
     def wait(self, rank: int, timeout: float, retries: int = 0,
-             backoff: float = 0.0) -> int:
+             backoff: float = 0.0, collect_arrivals=None) -> int:
         """Arrive and wait for the generation to fill.  Returns the
         number of retry extensions this waiter consumed (0 = the base
         timeout sufficed).  Raises :class:`_BarrierTimeout` when patience
         (base timeout + ``retries`` backoff extensions) runs out, and
-        :class:`_BarrierBroken` when another waiter broke the barrier."""
+        :class:`_BarrierBroken` when another waiter broke the barrier.
+
+        ``collect_arrivals`` (a list, health probes) receives the
+        completed round's per-rank arrival-timestamp dict — appended
+        UNDER the lock on the wake path, so every waiter of round k
+        reads round k's snapshot even if round k+1 starts immediately."""
         with self._cond:
             if self._broken:
                 if not self.resettable:
-                    raise _BarrierBroken(self.timeout_arrived)
+                    raise _BarrierBroken(self.timeout_arrived,
+                                         self.timeout_arrive_t)
                 # Wait (bounded) for the broken round's stragglers to
                 # drain, then start fresh — an immediate raise here
                 # would let a back-to-back probe race its peers' drain
@@ -232,21 +266,28 @@ class _AttributedBarrier:
                 while self._broken and self._count > 0:
                     remaining = drain_deadline - time.monotonic()
                     if remaining <= 0:
-                        raise _BarrierBroken(self.timeout_arrived)
+                        raise _BarrierBroken(self.timeout_arrived,
+                                             self.timeout_arrive_t)
                     self._cond.wait(remaining)
                 if self._broken:
                     self._broken = False
                     self.timeout_arrived = None
+                    self.timeout_arrive_t = {}
                     self._gen += 1
                 # else: a concurrent resettable arrival already reset it.
             gen = self._gen
             self._arrived.add(rank)
+            self._arrive_t[rank] = time.monotonic()
             self._count += 1
             if self._count == self.size:
+                self._last_arrivals = dict(self._arrive_t)
                 self._count = 0
                 self._arrived = set()
+                self._arrive_t = {}
                 self._gen += 1
                 self._cond.notify_all()
+                if collect_arrivals is not None:
+                    collect_arrivals.append(dict(self._last_arrivals))
                 return 0
             attempt = 0
             deadline = time.monotonic() + timeout
@@ -264,16 +305,20 @@ class _AttributedBarrier:
                         continue
                     arrived = frozenset(self._arrived)
                     self.timeout_arrived = arrived
+                    self.timeout_arrive_t = dict(self._arrive_t)
                     self._broken = True
                     self._drain(rank)
                     self._cond.notify_all()
-                    raise _BarrierTimeout(arrived)
+                    raise _BarrierTimeout(arrived, self.timeout_arrive_t)
                 self._cond.wait(remaining)
                 if self._gen != gen:
+                    if collect_arrivals is not None:
+                        collect_arrivals.append(dict(self._last_arrivals))
                     return attempt
                 if self._broken:
                     self._drain(rank)
-                    raise _BarrierBroken(self.timeout_arrived)
+                    raise _BarrierBroken(self.timeout_arrived,
+                                         self.timeout_arrive_t)
 
     def _drain(self, rank: int) -> None:
         """Leave a broken round (caller holds the lock): once the count
@@ -281,6 +326,7 @@ class _AttributedBarrier:
         any arrival waiting on the drain."""
         self._count -= 1
         self._arrived.discard(rank)
+        self._arrive_t.pop(rank, None)
         if self._count == 0:
             self._cond.notify_all()
 
@@ -291,6 +337,7 @@ class _AttributedBarrier:
                 # still attribute correctly (waiting probers are
                 # arrived, not missing).
                 self.timeout_arrived = frozenset(self._arrived)
+                self.timeout_arrive_t = dict(self._arrive_t)
             self._broken = True
             self._cond.notify_all()
 
@@ -465,6 +512,7 @@ class World:
                           "waits that eventually completed")
 
     def _wait_barrier(self, rank: int, meter=None):
+        t0 = time.perf_counter() if meter is not None else 0.0
         try:
             used = self._barrier.wait(rank, self.timeout,
                                       retries=_cfg.comm_retries(),
@@ -474,6 +522,14 @@ class World:
         except _BarrierBroken as b:
             self._raise_broken(b.arrived)
         else:
+            if meter is not None:
+                # Time spent BLOCKED on peers (vs the event's total
+                # duration, which includes this rank's own pre-barrier
+                # latency) — the gray-failure detector's signal: the
+                # slow rank is the one with high local time and ~zero
+                # wait, while everyone else waits on it
+                # (mpi4torch_tpu.resilience.health).
+                meter.add_wait(time.perf_counter() - t0)
             if used:
                 self._count_retries(used, meter)
 
@@ -541,17 +597,24 @@ class World:
         timeout = self.timeout if timeout is None else float(timeout)
         everyone = frozenset(range(self.size))
         t0 = time.monotonic()
+        arrivals: List[Dict[int, float]] = []
         try:
-            self._health.wait(rank, timeout, retries=0, backoff=0.0)
+            self._health.wait(rank, timeout, retries=0, backoff=0.0,
+                              collect_arrivals=arrivals)
         except _BarrierTimeout as t:
-            return self._health_report(False, t.arrived, everyone, t0)
+            return self._health_report(False, t.arrived, everyone, t0,
+                                       t.arrive_t)
         except _BarrierBroken as b:
             arrived = frozenset() if b.arrived is None else b.arrived
-            return self._health_report(False, arrived, everyone, t0)
-        return self._health_report(True, everyone, everyone, t0)
+            return self._health_report(False, arrived, everyone, t0,
+                                       b.arrive_t)
+        return self._health_report(True, everyone, everyone, t0,
+                                   arrivals[0] if arrivals else {})
 
     def _health_report(self, ok: bool, arrived: FrozenSet[int],
-                       everyone: FrozenSet[int], t0: float) -> HealthReport:
+                       everyone: FrozenSet[int], t0: float,
+                       arrive_t: Optional[Dict[int, float]] = None
+                       ) -> HealthReport:
         """Assemble a probe report and count it in the obs metrics
         registry (``comm_health_probes_total`` with an ok/failed result
         label) — probes are exceptional-path by construction, so the
@@ -562,9 +625,18 @@ class World:
         _metrics.inc(
             f'comm_health_probes_total{{result="{"ok" if ok else "failed"}"}}',
             help="health_check barrier probes by outcome")
+        # Per-rank arrival latency relative to the round's FIRST arrival
+        # (ISSUE 15): slow ranks carry a large offset, dead ranks carry
+        # none — check_health distinguishes slow from dead instead of
+        # collapsing both into `missing`.
+        arrival_s: Dict[int, float] = {}
+        if arrive_t:
+            first = min(arrive_t.values())
+            arrival_s = {r: t - first for r, t in arrive_t.items()
+                         if r in arrived}
         return HealthReport(ok, self.size, frozenset(arrived),
                             everyone - frozenset(arrived),
-                            probe_duration_s=dur)
+                            probe_duration_s=dur, arrival_s=arrival_s)
 
     # ------------------------------------------------------------------ p2p
 

@@ -48,7 +48,8 @@ import jax.numpy as jnp
 from .. import config as _config
 from ..utils.profiling import (ServeStats, reset_serve_stats,
                                serve_stats)
-from .engine import (Engine, POLICIES, QueueFullError, Request,
+from .engine import (Engine, POLICIES, SHED_POLICIES, STATUS_EXPIRED,
+                     STATUS_OK, STATUS_SHED, QueueFullError, Request,
                      ServeConfig)
 from .kv import (admit_zero3, decode_step_tp, init_kv_cache_tp,
                  prefill_tp, shard_params_tp, validate_tp)
@@ -58,6 +59,10 @@ __all__ = [
     "ServeConfig",
     "Request",
     "POLICIES",
+    "SHED_POLICIES",
+    "STATUS_OK",
+    "STATUS_EXPIRED",
+    "STATUS_SHED",
     "QueueFullError",
     "decode_step_tp",
     "prefill_tp",

@@ -43,6 +43,7 @@ Two execution modes behind one engine:
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -58,13 +59,28 @@ from ..utils import profiling as _prof
 from . import kv as _kv
 
 __all__ = ["ServeConfig", "Request", "Engine", "POLICIES",
-           "QueueFullError"]
+           "SHED_POLICIES", "QueueFullError",
+           "STATUS_OK", "STATUS_EXPIRED", "STATUS_SHED"]
+
+# Typed result statuses (ISSUE 15): every finished rid carries one.
+# ``expired`` = the request's deadline passed (queued requests return
+# the bare prompt; slotted ones keep the tokens emitted so far — a
+# bitwise PREFIX of the per-request generate() oracle).  ``shed`` =
+# evicted from the queue by the overload shed policy to admit newer
+# traffic.
+STATUS_OK = "ok"
+STATUS_EXPIRED = "deadline_expired"
+STATUS_SHED = "shed"
 
 
 class QueueFullError(CommError):
     """Raised by :meth:`Engine.submit` when the engine is at capacity
     (every slot occupied AND the bounded queue full) — the serving
-    backpressure signal a front-end turns into HTTP 429/503."""
+    backpressure signal a front-end turns into HTTP 429/503.  With a
+    ``ServeConfig.shed_policy`` configured, overload sheds a QUEUED
+    request (typed ``shed`` result status) instead of raising — the
+    load-shedding alternative for traffic where newest-wins (or
+    oldest-wins) beats reject-newest."""
 
 
 def _policy_fcfs(queue) -> int:
@@ -90,6 +106,29 @@ POLICIES = {
 }
 
 
+def _shed_oldest(queue) -> int:
+    """Shed the longest-waiting queued request (newest traffic wins —
+    the steady-overload choice: old queued work is the most likely to
+    blow its deadline anyway)."""
+    return 0
+
+
+def _shed_newest(queue) -> int:
+    """Shed the most recent arrival (oldest-first fairness: requests
+    already queued keep their place)."""
+    return len(queue) - 1
+
+
+# Overload shed policies: name -> chooser(queue) -> index of the queued
+# request to shed when a submit overflows capacity.  Closed registry
+# like POLICIES — the serve deadline/shed test matrix parametrizes over
+# it, and chaos-matrix coverage is registry-sync guarded.
+SHED_POLICIES = {
+    "drop_oldest": _shed_oldest,
+    "drop_newest": _shed_newest,
+}
+
+
 @dataclass(frozen=True)
 class ServeConfig:
     """Engine configuration.  ``slots`` is the fixed slot-table
@@ -104,7 +143,11 @@ class ServeConfig:
     ``queue_limit`` bounds the waiting queue beyond what free slots can
     immediately absorb: a submit is rejected once
     ``queued >= queue_limit + free_slots`` (None = unbounded; 0 =
-    accept only what a free slot can take right now)."""
+    accept only what a free slot can take right now).  ``shed_policy``
+    (None = reject with :class:`QueueFullError`) turns that rejection
+    into load shedding: a QUEUED request is evicted with the typed
+    ``shed`` result status and the new submit is accepted —
+    :data:`SHED_POLICIES` picks the victim."""
     slots: int = 4
     max_new: int = 16
     eos: Optional[int] = None
@@ -115,6 +158,7 @@ class ServeConfig:
     algorithm: Optional[str] = None
     queue_limit: Optional[int] = None
     cache_dtype: Any = None
+    shed_policy: Optional[str] = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -132,6 +176,12 @@ class ServeConfig:
             raise ValueError(
                 f"queue_limit must be >= 0 or None, got "
                 f"{self.queue_limit}")
+        if self.shed_policy is not None \
+                and self.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"unknown shed policy {self.shed_policy!r}; registered: "
+                f"{sorted(SHED_POLICIES)} (or None to reject with "
+                "QueueFullError)")
 
 
 @dataclass(eq=False)
@@ -139,13 +189,16 @@ class Request:
     """One serving request: ``prompt`` (1-d int array), its token
     budget, and (for sampled decoding) its own PRNG key — the exact
     argument set of a per-request ``generate()`` call, which is the
-    engine's parity oracle.  Identity-compared (``eq=False``): the
-    queue removes by object, and array fields have no useful value
-    equality."""
+    engine's parity oracle.  ``deadline`` is the ABSOLUTE engine-clock
+    instant past which the request is evicted with the typed
+    ``deadline_expired`` status (None = no deadline).
+    Identity-compared (``eq=False``): the queue removes by object, and
+    array fields have no useful value equality."""
     rid: Any
     prompt: np.ndarray
     max_new: int
     key: Any = None
+    deadline: Optional[float] = None
     emitted: List[int] = field(default_factory=list)
 
     def finished(self, eos: Optional[int]) -> bool:
@@ -170,9 +223,20 @@ class Engine:
     def __init__(self, cfg: TransformerConfig, params,
                  serve_cfg: ServeConfig = None, *, spmd: bool = False,
                  nranks: Optional[int] = None, mesh=None,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None, clock=None):
         self.cfg = cfg
         self.serve_cfg = serve_cfg or ServeConfig()
+        # The deadline clock: monotonic seconds.  Injectable so the
+        # deadline-eviction tests (and the chaos matrix) drive a FAKE
+        # clock deterministically — expirations then depend on the step
+        # schedule, not on wall-time noise.  Multi-rank Mode B serving
+        # (one Engine per rank thread) MUST inject the same
+        # deterministic clock on every rank: each engine runs its own
+        # expiry sweep, and per-rank wall clocks can disagree on which
+        # step a deadline lands in — a divergent eviction would split
+        # the slot tables feeding the decode collectives.  The default
+        # wall clock is for single-engine deployments.
+        self._clock = clock if clock is not None else time.monotonic
         self._spmd = bool(spmd)
         self._comm = COMM_WORLD
         if self._spmd:
@@ -234,6 +298,7 @@ class Engine:
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._queue: deque = deque()
         self._results: Dict[Any, np.ndarray] = {}
+        self._statuses: Dict[Any, str] = {}
         self._known_rids = set()
         self._next_rid = 0
         self.slot_log: List[tuple] = []   # (rid, slot) admission history
@@ -271,11 +336,16 @@ class Engine:
     # -------------------------------------------------------------- public
 
     def submit(self, prompt, *, rid=None, max_new: Optional[int] = None,
-               key=None):
+               key=None, deadline_s: Optional[float] = None):
         """Queue one request; returns its id.  Validates the
         ``generate()`` preconditions (budget fits ``max_seq``, sampled
         decoding needs a key) and applies queue backpressure
-        (:class:`QueueFullError` past ``queue_limit``)."""
+        (:class:`QueueFullError` past ``queue_limit``, or a shed per
+        ``ServeConfig.shed_policy``).  ``deadline_s`` (seconds from
+        now on the engine clock) bounds the request's total latency:
+        past it the request is evicted with the typed
+        ``deadline_expired`` result status — whatever tokens it emitted
+        stay a bitwise prefix of the ``generate()`` oracle."""
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError(
@@ -291,6 +361,9 @@ class Engine:
                 f"{self.cfg.max_seq}")
         if self.serve_cfg.temperature > 0 and key is None:
             raise ValueError("temperature > 0 requires a PRNG `key`")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 seconds, got {deadline_s}")
         limit = self.serve_cfg.queue_limit
         if limit is not None and \
                 len(self._queue) >= limit + len(self._free_slots()):
@@ -298,12 +371,21 @@ class Engine:
             # free slots count as immediate capacity (the next step
             # admits into them), everything beyond slots + limit is
             # rejected — the queue stays bounded even before the first
-            # step runs.
-            self.stats.count("rejected")
-            raise QueueFullError(
-                f"serve queue full ({len(self._queue)} waiting, "
-                f"{len(self._free_slots())} free of "
-                f"{self.serve_cfg.slots} slots; queue_limit={limit})")
+            # step runs.  A configured shed policy evicts a QUEUED
+            # victim (typed `shed` status) instead of rejecting the
+            # newcomer; with nothing queued to shed, rejection stands.
+            if self.serve_cfg.shed_policy is not None and self._queue:
+                victim = self._queue[
+                    SHED_POLICIES[self.serve_cfg.shed_policy](
+                        self._queue)]
+                self._queue.remove(victim)
+                self._finish(victim, status=STATUS_SHED)  # counts "shed"
+            else:
+                self.stats.count("rejected")
+                raise QueueFullError(
+                    f"serve queue full ({len(self._queue)} waiting, "
+                    f"{len(self._free_slots())} free of "
+                    f"{self.serve_cfg.slots} slots; queue_limit={limit})")
         if rid is None:
             rid = self._next_rid
             self._next_rid += 1
@@ -314,9 +396,35 @@ class Engine:
                 f"request id {rid!r} is already in use by a queued, "
                 "in-flight, or finished request of this engine")
         self._known_rids.add(rid)
+        deadline = (None if deadline_s is None
+                    else self._clock() + float(deadline_s))
         self._queue.append(Request(rid=rid, prompt=prompt,
-                                   max_new=budget, key=key))
+                                   max_new=budget, key=key,
+                                   deadline=deadline))
         self.stats.mark(rid, "submitted")
+        return rid
+
+    def admit_expired(self, prompt, *, rid=None, emitted=()):
+        """Record a request that arrives ALREADY past its deadline —
+        the elastic re-admission path, where resize downtime can
+        consume a drained ticket's remaining deadline budget — with the
+        typed ``deadline_expired`` result status.  The tokens it
+        carries stay whatever oracle prefix it had earned; no prefill,
+        slot, or decode step is spent.  Validates ``rid`` uniqueness
+        exactly like :meth:`submit`."""
+        prompt = np.asarray(prompt)
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        elif rid in self._known_rids:
+            raise ValueError(
+                f"request id {rid!r} is already in use by a queued, "
+                "in-flight, or finished request of this engine")
+        self._known_rids.add(rid)
+        req = Request(rid=rid, prompt=prompt, max_new=0,
+                      emitted=list(emitted))
+        self.stats.mark(rid, "submitted")
+        self._finish(req, status=STATUS_EXPIRED)
         return rid
 
     def pending(self) -> int:
@@ -391,12 +499,13 @@ class Engine:
             self._tokens[j] = tok
             self._pos[j] = int(req.prompt.size)
 
-    def _finish(self, req: Request) -> None:
+    def _finish(self, req: Request, status: str = STATUS_OK) -> None:
         self._results[req.rid] = np.concatenate(
             [np.asarray(req.prompt, np.int64),
              np.asarray(req.emitted, np.int64)])
+        self._statuses[req.rid] = status
         self.stats.mark(req.rid, "finished")
-        self.stats.count("finished")
+        self.stats.count("finished" if status == STATUS_OK else status)
 
     def _release_slots(self, idxs: List[int]) -> None:
         """Return slots to the free pool and re-poison their cache
@@ -418,11 +527,30 @@ class Engine:
                 self._cache = jax.tree.map(
                     lambda s: s.at[arr].set(jnp.nan), self._cache)
 
-    def _evict(self, j: int) -> None:
+    def _evict(self, j: int, status: str = STATUS_OK) -> None:
         req = self._slot_req[j]
         self._release_slots([j])
         self.stats.count("evicted")
-        self._finish(req)
+        self._finish(req, status=status)
+
+    def _expire_sweep(self, events: dict) -> None:
+        """Deadline sweep, run at the top of every step: queued
+        requests past their deadline finish as bare prompts, slotted
+        ones are evicted keeping the tokens emitted so far (a bitwise
+        PREFIX of the generate() oracle) — both with the typed
+        ``deadline_expired`` status, reported through the step-event
+        surface like any other completion."""
+        now = self._clock()
+        for req in [r for r in self._queue
+                    if r.deadline is not None and now >= r.deadline]:
+            self._queue.remove(req)
+            self._finish(req, status=STATUS_EXPIRED)
+            events["expired"].append(req.rid)
+        for j, req in enumerate(self._slot_req):
+            if req is not None and req.deadline is not None \
+                    and now >= req.deadline:
+                self._evict(j, status=STATUS_EXPIRED)
+                events["expired"].append(req.rid)
 
     def step(self) -> dict:
         """Admissions, then ONE decode step over the slot table, then
@@ -434,8 +562,14 @@ class Engine:
         first-token and its first decode token), so a front-end
         driving replies off ``step()`` never misses one.
         Finished requests' full sequences accumulate for
-        :meth:`results`/:meth:`run`."""
-        events = {"admitted": [], "emitted": {}, "finished": []}
+        :meth:`results`/:meth:`run`; deadline-expired evictions are
+        reported under ``events["expired"]`` (typed
+        ``deadline_expired`` result status) after the sweep that runs
+        BEFORE admission — an expired queued request never burns a
+        prefill."""
+        events = {"admitted": [], "emitted": {}, "finished": [],
+                  "expired": []}
+        self._expire_sweep(events)
         self._admit(events)
         active = [j for j, r in enumerate(self._slot_req)
                   if r is not None]
@@ -484,6 +618,16 @@ class Engine:
     def results(self) -> Dict[Any, np.ndarray]:
         return dict(self._results)
 
+    def statuses(self) -> Dict[Any, str]:
+        """Typed result status per finished rid: ``"ok"`` (ran to
+        EOS/budget), ``"deadline_expired"`` (evicted past its
+        deadline; its result is the oracle-prefix it got to), or
+        ``"shed"`` (queue-evicted by the overload shed policy)."""
+        return dict(self._statuses)
+
+    def status(self, rid) -> Optional[str]:
+        return self._statuses.get(rid)
+
     def pop_results(self) -> Dict[Any, np.ndarray]:
         """Retrieve-and-drop every finished result, releasing its
         request id and memory — the steady-state serving API: a
@@ -492,6 +636,8 @@ class Engine:
         reused by a later :meth:`submit`."""
         out, self._results = self._results, {}
         self._known_rids.difference_update(out)
+        for rid in out:
+            self._statuses.pop(rid, None)
         return out
 
     # ------------------------------------------------------------ elastic
@@ -509,7 +655,8 @@ class Engine:
                  "prompt": np.array(r.prompt, copy=True),
                  "emitted": list(r.emitted),
                  "max_new": r.max_new,
-                 "key": r.key} for r in recs]
+                 "key": r.key,
+                 "deadline": r.deadline} for r in recs]
 
     def snapshot_inflight(self) -> List[dict]:
         """Non-destructive :meth:`drain`: the same records, with the

@@ -119,7 +119,10 @@ class ServeStats:
     span dict would grow with total traffic served)."""
 
     _COUNTERS = ("steps", "admitted", "evicted", "finished", "rejected",
-                 "decode_tokens", "occupancy_ticks", "slot_ticks")
+                 "decode_tokens", "occupancy_ticks", "slot_ticks",
+                 # ISSUE 15: typed non-ok completions — deadline-expired
+                 # evictions and shed-policy queue evictions.
+                 "deadline_expired", "shed")
     SPAN_CAP = 1024
 
     def __init__(self):

@@ -1,0 +1,439 @@
+"""Gray-failure robustness (ISSUE 15): performance-fault kinds,
+slow-rank detection, and the lock-step degraded-mode runtime.
+
+Pins the tentpole contracts:
+
+* the four performance-fault kinds (``slow_rank``/``jitter``/
+  ``flaky_link``/``brownout``) are deterministic (seeded draws replay
+  bit-for-bit), censused (brownout's throttle is proportional to the
+  censused payload bytes, recorded in the fired ledger), and
+  registry-sync guarded into BOTH matrices;
+* ``comm.check_health`` distinguishes slow from dead: per-rank
+  ``arrival_s`` latencies next to the ``missing`` set;
+* the detector attributes the slow rank POSITIVELY off the
+  ``duration - wait`` split of the CommEvent stream, counts detections
+  in the metrics registry, and escalates to a typed, attributed
+  ``SlowRankError`` with a flight-recorder postmortem;
+* the degrade policies are a closed registry; transitions are
+  epoch-fenced through the elastic consensus and fully reversible
+  (``DegradeController.reset``); the per-rank wire census ranking the
+  schedule failover is self-consistent (every candidate moves the same
+  total wire — concentration, not volume, differs);
+* the chaos matrix's fast subset runs in tier-1; the FULL matrix and
+  the seeded storms ride the ``slow`` lane and ``make chaos-smoke``.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4torch_tpu as mpi
+from mpi4torch_tpu import obs
+from mpi4torch_tpu import resilience as rz
+from mpi4torch_tpu.analyze.registry import degrade_problems
+from mpi4torch_tpu.obs.events import payload_nbytes
+from mpi4torch_tpu.resilience import chaos as rchaos
+from mpi4torch_tpu.resilience import degrade as rdegrade
+from mpi4torch_tpu.resilience import matrix as rmatrix
+from mpi4torch_tpu.resilience.faults import _hash01
+
+comm = mpi.COMM_WORLD
+
+
+@pytest.fixture(autouse=True)
+def _restore_config():
+    yield
+    mpi.config.set_comm_retries(0)
+    mpi.config.set_comm_backoff(0.05)
+    mpi.config.set_fault_plan(None)
+    mpi.config.set_default_compression(None)
+    mpi.config.set_default_algorithm(None)
+
+
+def _run_traced(body, nranks, specs, retries=5, backoff=0.2,
+                timeout=10.0):
+    with rmatrix._knob(comm_retries=retries, comm_backoff=backoff), \
+            rz.fault_scope(specs) as plan, obs.trace() as tracer:
+        outs = mpi.run_ranks(body, nranks, timeout=timeout)
+    return outs, plan, tracer
+
+
+# =========================================================================
+# The gray fault kinds
+# =========================================================================
+
+class TestGrayFaultKinds:
+    def test_registered_with_matrix_rows(self):
+        for kind in rchaos.GRAY_KINDS:
+            assert kind in rz.FAULT_KINDS
+            assert rz.FAULT_KINDS[kind].transient
+            assert kind in rmatrix.COVERAGE
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="p must be in"):
+            rz.FaultSpec("flaky_link", p=1.5)
+        with pytest.raises(ValueError, match="per_byte_s"):
+            rz.FaultSpec("brownout", per_byte_s=-1.0)
+
+    def test_seeded_draws_deterministic(self):
+        draws = [_hash01(7, r, i) for r in range(3) for i in range(5)]
+        again = [_hash01(7, r, i) for r in range(3) for i in range(5)]
+        assert draws == again
+        assert all(0.0 <= d < 1.0 for d in draws)
+        # Different seeds give different storms.
+        assert draws != [_hash01(8, r, i) for r in range(3)
+                         for i in range(5)]
+
+    def test_jitter_fires_with_recorded_sleep(self):
+        spec = rz.FaultSpec("jitter", rank=1, op="Allreduce",
+                            seconds=0.02, count=3, seed=5)
+
+        def body(rank):
+            out = None
+            for _ in range(3):
+                out = comm.Allreduce(jnp.arange(8.0) * (rank + 1),
+                                     mpi.MPI_SUM)
+            return np.asarray(out)
+
+        _outs, plan, _t = _run_traced(body, 3, [spec])
+        fires = [f for f in plan.fired if f.kind == "jitter"]
+        assert len(fires) == 3
+        want = [0.02 * _hash01(5, 1, i) for i in range(3)]
+        assert [f.info["sleep_s"] for f in fires] == want
+
+    def test_brownout_throttle_proportional_to_censused_bytes(self):
+        spec = rz.FaultSpec("brownout", rank=0, op="Allreduce",
+                            per_byte_s=1e-4, count=2)
+        x_small = jnp.arange(16, dtype=jnp.float32)
+        x_big = jnp.arange(256, dtype=jnp.float32)
+
+        def body(rank):
+            a = comm.Allreduce(x_small * (rank + 1), mpi.MPI_SUM)
+            b = comm.Allreduce(x_big * (rank + 1), mpi.MPI_SUM)
+            return np.asarray(a), np.asarray(b)
+
+        _outs, plan, _t = _run_traced(body, 2, [spec])
+        fires = [f for f in plan.fired if f.kind == "brownout"]
+        assert len(fires) == 2
+        assert fires[0].info["bytes"] == payload_nbytes(x_small)
+        assert fires[1].info["bytes"] == payload_nbytes(x_big)
+        for f in fires:
+            assert f.info["sleep_s"] == pytest.approx(
+                1e-4 * f.info["bytes"])
+
+    def test_flaky_link_p0_never_fires_p1_always_drops(self):
+        def body(rank):
+            if rank == 0:
+                comm.Wait(comm.Isend(jnp.arange(4.0), 1, 3))
+                return None
+            return np.asarray(comm.Wait(comm.Irecv(jnp.zeros(4), 0, 3)))
+
+        never = rz.FaultSpec("flaky_link", rank=0, op="p2p", p=0.0,
+                             count=10)
+        outs, plan, _t = _run_traced(body, 2, [never])
+        assert "flaky_link" not in plan.fired_kinds()
+        np.testing.assert_array_equal(outs[1], np.arange(4.0))
+
+        always = rz.FaultSpec("flaky_link", rank=0, op="p2p", p=1.0,
+                              count=1)
+        outs, plan, _t = _run_traced(body, 2, [always])
+        assert "flaky_link" in plan.fired_kinds()   # dropped AND redelivered
+        np.testing.assert_array_equal(outs[1], np.arange(4.0))
+
+    def test_gray_matrix_cells_fast_subset(self):
+        # One representative matrix cell per gray kind on (3,) — the
+        # full sweep rides the slow lane via TestFaultMatrixFull.
+        for kind, subsystem in [("slow_rank", "plain"),
+                                ("jitter", "fused"),
+                                ("brownout", "compressed"),
+                                ("flaky_link", "overlap"),
+                                ("flaky_link", "plain")]:
+            rec = rmatrix.run_cell(kind, subsystem, nranks=3)
+            assert rec["status"] == "ok", rec
+
+
+# =========================================================================
+# Registry-sync guards
+# =========================================================================
+
+class TestRegistryGuards:
+    def test_degrade_guard_clean(self):
+        assert degrade_problems() == []
+
+    def test_unregistered_policy_fails(self):
+        rdegrade.DEGRADE_POLICIES["ghost_policy"] = lambda c, r: {}
+        try:
+            problems = degrade_problems()
+            assert problems and "ghost_policy" in " ".join(problems)
+        finally:
+            del rdegrade.DEGRADE_POLICIES["ghost_policy"]
+
+    def test_gray_kind_without_chaos_row_fails(self):
+        row = rchaos.CHAOS_COVERAGE.pop("jitter")
+        try:
+            problems = degrade_problems()
+            assert problems and "jitter" in " ".join(problems)
+        finally:
+            rchaos.CHAOS_COVERAGE["jitter"] = row
+
+    def test_standing_problems_includes_degrade(self):
+        from mpi4torch_tpu.analyze.registry import standing_problems
+        rdegrade.DEGRADE_POLICIES["ghost_policy"] = lambda c, r: {}
+        try:
+            assert any("degrade:" in p for p in standing_problems())
+        finally:
+            del rdegrade.DEGRADE_POLICIES["ghost_policy"]
+
+
+# =========================================================================
+# check_health: slow vs dead
+# =========================================================================
+
+class TestHealthArrivalLatency:
+    def test_slow_rank_arrives_late_but_alive(self):
+        def probe(rank):
+            if rank == 2:
+                time.sleep(0.2)
+            return comm.check_health(timeout=5.0)
+
+        reps = mpi.run_ranks(probe, 3, timeout=10.0)
+        for rep in reps:
+            assert rep.ok and not rep.missing
+            assert set(rep.arrival_s) == {0, 1, 2}
+            assert rep.arrival_s[2] >= 0.15
+            assert rep.slow_ranks(0.1) == frozenset({2})
+            assert rep.slow_ranks(10.0) == frozenset()
+
+    def test_dead_rank_is_missing_not_slow(self):
+        def probe(rank):
+            if rank == 1:
+                return None     # never probes: the dead/hung stand-in
+            return comm.check_health(timeout=0.3)
+
+        reps = mpi.run_ranks(probe, 3, timeout=5.0)
+        for rank, rep in enumerate(reps):
+            if rank == 1:
+                continue
+            assert not rep.ok
+            assert rep.missing == frozenset({1})
+            # The dead rank has NO arrival entry — slow and dead are
+            # different answers now.
+            assert 1 not in rep.arrival_s
+            assert rep.slow_ranks(10.0) == frozenset()
+
+
+# =========================================================================
+# The detector
+# =========================================================================
+
+def _ev(rank, dur, wait, world=0, size=4, status="ok",
+        channel="exchange"):
+    from mpi4torch_tpu.obs.events import CommEvent
+
+    return CommEvent(seq=0, rank=rank, world=world, world_size=size,
+                     channel=channel, op="Allreduce",
+                     duration_s=dur, wait_s=wait, status=status)
+
+
+class TestDetector:
+    def test_synthetic_positive_attribution(self):
+        events = []
+        for _ in range(4):
+            events += [_ev(0, 0.1, 0.099), _ev(1, 0.1, 0.001),
+                       _ev(2, 0.1, 0.098), _ev(3, 0.1, 0.097)]
+        rep = rz.detect_slow_ranks(events, floor_s=0.01)
+        assert rep.slow == frozenset({1})
+        assert rep.stat(1).local_s > rep.stat(0).local_s
+        assert rep.world_size == 4
+
+    def test_quiet_world_flags_nobody(self):
+        events = [_ev(r, 1e-4, 5e-5) for r in range(4)] * 3
+        rep = rz.detect_slow_ranks(events, floor_s=0.01)
+        assert rep.slow == frozenset()
+
+    def test_failed_events_and_recv_channel_excluded(self):
+        events = [_ev(0, 9.0, 0.0, status="DeadlockError"),
+                  _ev(0, 9.0, 0.0, channel="p2p_recv"),
+                  _ev(0, 1e-4, 0.0), _ev(0, 1e-4, 0.0),
+                  _ev(1, 1e-4, 0.0), _ev(1, 1e-4, 0.0)]
+        rep = rz.detect_slow_ranks(events, floor_s=0.01)
+        assert rep.slow == frozenset()
+
+    def test_world_selection_prefers_busiest(self):
+        events = ([_ev(0, 0.2, 0.0, world=0, size=2),
+                   _ev(1, 1e-4, 0.0, world=0, size=2)] * 3
+                  + [_ev(0, 1e-4, 0.0, world=1, size=2)])
+        rep = rz.detect_slow_ranks(events, floor_s=0.01)
+        assert rep.world == 0 and rep.slow == frozenset({0})
+
+    def test_no_tracer_reports_none(self):
+        assert rz.GrayFailureDetector().report() is None
+
+    def test_mode_b_end_to_end_detection_and_metrics(self):
+        from mpi4torch_tpu.obs import metrics as ometrics
+
+        def body(rank):
+            out = None
+            for _ in range(3):
+                out = comm.Allreduce(jnp.arange(8.0) * (rank + 1),
+                                     mpi.MPI_SUM)
+            return np.asarray(out)
+
+        spec = rz.FaultSpec("slow_rank", rank=1, op="Allreduce",
+                            seconds=0.08, count=10)
+        before = ometrics.snapshot()["counters"].get(
+            "gray_failures_total", 0)
+        _outs, plan, tracer = _run_traced(body, 4, [spec])
+        rep = rz.GrayFailureDetector(tracer, floor_s=0.02).check()
+        assert rep is not None and rep.slow == frozenset({1})
+        assert "slow_rank" in plan.fired_kinds()
+        after = ometrics.snapshot()["counters"]["gray_failures_total"]
+        assert after == before + 1
+
+    def test_escalation_typed_attributed_with_postmortem(self):
+        def body(rank):
+            for _ in range(3):
+                comm.Allreduce(jnp.arange(8.0) * (rank + 1),
+                               mpi.MPI_SUM)
+
+        spec = rz.FaultSpec("slow_rank", rank=2, op="Allreduce",
+                            seconds=0.08, count=10)
+        _outs, _plan, tracer = _run_traced(body, 3, [spec])
+        det = rz.GrayFailureDetector(tracer, floor_s=0.02)
+        with pytest.raises(rz.SlowRankError) as ei:
+            det.check(escalate=True)
+        assert ei.value.ranks == frozenset({2})
+        assert ei.value.report.slow == frozenset({2})
+        pm = tracer.last_postmortem()
+        assert pm is not None and pm["error"] == "SlowRankError"
+        assert pm["failed_ranks"] == [2]
+
+    def test_prometheus_exposition_of_gray_counters(self):
+        from mpi4torch_tpu.obs import metrics as ometrics
+
+        ometrics.inc("gray_failures_total",
+                     help="slow ranks flagged")
+        ometrics.inc('degrade_transitions_total{policy="codec_escalate"}',
+                     help="degrade transitions")
+        text = ometrics.prometheus_text()
+        assert "mpi4torch_gray_failures_total " in text
+        assert ('mpi4torch_degrade_transitions_total'
+                '{policy="codec_escalate"}') in text
+        # Label-carrying names keep bare-family TYPE headers.
+        assert "# TYPE mpi4torch_degrade_transitions_total counter" \
+            in text
+
+
+# =========================================================================
+# Degrade policies
+# =========================================================================
+
+class TestPerRankWireCensus:
+    def test_totals_identical_across_candidates(self):
+        # Same traffic, different concentration: every candidate's
+        # TOTAL is 4(N-1)B (up to the per-rank integer rounding on
+        # worlds that do not divide the payload).
+        for n in (3, 4, 8):
+            want = 4 * (n - 1) * (1 << 10)
+            for algo in ("ring", "bidir", "tree"):
+                t = rz.rank_wire_bytes(algo, n, 1 << 10)
+                assert len(t) == n
+                assert abs(sum(t) - want) <= n // 2, (algo, n)
+
+    def test_tree_concentrates_on_root(self):
+        t = rz.rank_wire_bytes("tree", 8, 1024, root=4)
+        assert t[4] == 2 * 3 * 1024 * 2 // 2      # 2·log2(8)·B = 6144
+        assert t[(4 + 1) % 8] == 2 * 1024          # odd-relative leaf
+        assert max(t) == t[4]
+
+    def test_one_rank_world_is_zero_wire(self):
+        assert rz.rank_wire_bytes("ring", 1, 1024) == [0]
+
+    def test_unknown_algorithm_typed(self):
+        with pytest.raises(rz.DegradeError, match="no per-rank wire"):
+            rz.rank_wire_bytes("warp", 4, 1024)
+
+    def test_failover_unloads_slow_rank_deterministically(self):
+        w1, table = rz.failover_schedule(3, 8, 1024)
+        w2, _ = rz.failover_schedule(3, 8, 1024)
+        assert w1 == w2
+        assert table[w1][3] < table["ring"][3]
+        # rhd only offered on power-of-two worlds.
+        _w, table3 = rz.failover_schedule(0, 3, 1024)
+        assert "rhd" not in table3
+
+
+class TestDegradeController:
+    def test_unknown_policy_typed(self):
+        ctl = rz.DegradeController(n_ranks=2)
+        with pytest.raises(rz.DegradeError, match="unknown degrade"):
+            ctl.apply("warp_drive", consensus=False)
+
+    def test_codec_escalate_epoch_fenced_and_reversible(self):
+        ctl = rz.DegradeController(n_ranks=2)
+        rep = rz.SlowRankReport(world=0, world_size=2, stats=(),
+                                slow=frozenset({1}), baseline_s=0.0,
+                                threshold=4.0, floor_s=0.01)
+        tr = ctl.apply("codec_escalate", rep)
+        assert tr.epoch == 1 == ctl.runtime.epoch
+        assert getattr(mpi.config.default_compression(), "name",
+                       None) == "q8"
+        from mpi4torch_tpu.obs import metrics as ometrics
+        counters = ometrics.snapshot()["counters"]
+        assert counters[
+            'degrade_transitions_total{policy="codec_escalate"}'] >= 1
+        ctl.reset()
+        assert mpi.config.default_compression() is None
+
+    def test_schedule_failover_requires_report(self):
+        ctl = rz.DegradeController(n_ranks=4)
+        with pytest.raises(rz.DegradeError, match="SlowRankReport"):
+            ctl.apply("schedule_failover", consensus=False)
+
+    def test_spare_demote_without_spare_names_fallback(self):
+        ctl = rz.DegradeController(n_ranks=2)
+        rep = rz.SlowRankReport(world=0, world_size=2, stats=(),
+                                slow=frozenset({1}), baseline_s=0.0,
+                                threshold=4.0, floor_s=0.01)
+        with pytest.raises(rz.DegradeError, match="planned elastic"):
+            ctl.apply("spare_demote", rep, consensus=False, n_data=2)
+
+
+# =========================================================================
+# Chaos matrix: fast subset (tier-1) + full sweep (slow)
+# =========================================================================
+
+_FAST_CHAOS = [
+    ("slow_rank", "plain"),      # degrade: schedule failover, lock-step
+    ("jitter", "plain"),         # recover under the storm
+    ("flaky_link", "overlap"),   # recover via redelivery
+    ("flaky_link", "plain"),     # provably inert
+]
+
+
+class TestChaosFast:
+    @pytest.mark.parametrize("kind,subsystem", _FAST_CHAOS)
+    def test_cell(self, kind, subsystem):
+        rec = rchaos.run_chaos_cell(kind, subsystem)
+        assert rec["status"] == "ok", rec
+
+    def test_storm_never_hangs(self):
+        rec = rchaos.run_storm(1)
+        assert rec["status"] == "ok", rec
+        assert set(rec["fired"]) == set(rchaos.GRAY_KINDS)
+
+
+@pytest.mark.slow
+class TestChaosFull:
+    @pytest.mark.parametrize("kind,subsystem",
+                             list(rchaos.coverage_cells()))
+    def test_cell(self, kind, subsystem):
+        rec = rchaos.run_chaos_cell(kind, subsystem)
+        assert rec["status"] == "ok", rec
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_storm(self, seed):
+        rec = rchaos.run_storm(seed)
+        assert rec["status"] == "ok", rec

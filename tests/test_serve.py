@@ -538,3 +538,164 @@ class TestFaultComposition:
             with pytest.raises(mpi.RankFailedError) as ei:
                 mpi.run_ranks(fn, 2, timeout=20.0)
         assert ei.value.ranks == frozenset({1})
+
+
+class TestDeadlinesAndShedding:
+    """ISSUE 15: deadline-expired eviction (typed result status, tokens
+    a bitwise PREFIX of the per-request generate() oracle) and the
+    overload shed policies — identical across the (1,), (4,) and (2,4)
+    worlds, because expiry is driven by the engine's injectable clock
+    and the host step loop, not by wall time."""
+
+    def _drive_with_deadlines(self, eng, t):
+        # rid 0 expires mid-flight (slotted), rid 3 expires while still
+        # queued; rids 1/2 run to budget.  The fake clock advances one
+        # "second" per step, so the eviction schedule is exact.
+        eng.submit(PROMPTS[0], max_new=BUDGETS[0], deadline_s=2.5)
+        eng.submit(PROMPTS[1], max_new=BUDGETS[1])
+        eng.submit(PROMPTS[2], max_new=BUDGETS[2])
+        eng.submit(PROMPTS[3], max_new=BUDGETS[3], deadline_s=1.5)
+        expired = []
+        for _ in range(32):
+            ev = eng.step()
+            expired += ev["expired"]
+            t[0] += 1.0
+            if not eng.pending():
+                break
+        return expired, eng.results(), eng.statuses()
+
+    def _check(self, expired, results, statuses):
+        params = self._params_cache
+        assert statuses[0] == serve.STATUS_EXPIRED
+        assert statuses[3] == serve.STATUS_EXPIRED
+        assert statuses[1] == serve.STATUS_OK
+        assert statuses[2] == serve.STATUS_OK
+        assert sorted(expired) == [0, 3]
+        # Finished requests: full oracle parity.
+        for i in (1, 2):
+            np.testing.assert_array_equal(
+                np.asarray(results[i]),
+                oracle_tokens(CFG, params, PROMPTS[i], BUDGETS[i]))
+        # The slotted eviction kept an oracle PREFIX (it decoded >= 1
+        # token before expiring); the queued eviction is a bare prompt.
+        want0 = oracle_tokens(CFG, params, PROMPTS[0], BUDGETS[0])
+        got0 = np.asarray(results[0])
+        assert len(PROMPTS[0]) < len(got0) < len(want0)
+        np.testing.assert_array_equal(got0, want0[:len(got0)])
+        np.testing.assert_array_equal(np.asarray(results[3]),
+                                      np.asarray(PROMPTS[3], np.int64))
+
+    @pytest.mark.parametrize("world", ["local1", "spmd4", "mesh2x4"])
+    def test_deadline_evictions_bitwise_vs_oracle(self, world):
+        params = self._params_cache = _params(CFG)
+        t = [0.0]
+        kw = {"clock": lambda: t[0]}
+        if world == "spmd4":
+            kw.update(spmd=True, nranks=4)
+        elif world == "mesh2x4":
+            mesh = mpi.device_mesh({"dp": 2, "tp": 4})
+            kw.update(spmd=True, mesh=mesh, axis_name="tp")
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=2), **kw)
+        self._check(*self._drive_with_deadlines(eng, t))
+        snap = eng.stats.snapshot()
+        assert snap["deadline_expired"] == 2
+        assert snap["finished"] == 2
+
+    @pytest.mark.parametrize("policy", sorted(serve.SHED_POLICIES))
+    def test_shed_policy_typed_and_bitwise(self, policy):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=1, queue_limit=2,
+                                             shed_policy=policy))
+        eng.submit(PROMPTS[0], max_new=4)
+        eng.step()                      # rid 0 takes (and keeps) the slot
+        eng.submit(PROMPTS[1], max_new=2)
+        eng.submit(PROMPTS[2], max_new=2)
+        eng.submit(PROMPTS[3], max_new=2)   # overflow -> shed
+        # The victim is chosen among QUEUED requests at submit time:
+        # oldest = rid 1, newest = rid 2 (rid 3 is not queued yet).
+        victim = 1 if policy == "drop_oldest" else 2
+        assert eng.status(victim) == serve.STATUS_SHED
+        np.testing.assert_array_equal(
+            np.asarray(eng.results()[victim]),
+            np.asarray(PROMPTS[victim], np.int64))
+        res = eng.run()
+        survivors = [r for r in (0, 1, 2, 3) if r != victim]
+        for i in survivors:
+            assert eng.status(i) == serve.STATUS_OK
+            np.testing.assert_array_equal(
+                np.asarray(res[i]),
+                oracle_tokens(CFG, params, PROMPTS[i], 4 if i == 0
+                              else 2))
+        assert eng.stats.snapshot()["shed"] == 1
+
+    def test_shed_policy_none_keeps_queue_full_error(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=1, queue_limit=0))
+        eng.submit(PROMPTS[0], max_new=4)
+        eng.step()      # rid 0 occupies the only slot; queue bound is 0
+        with pytest.raises(serve.QueueFullError):
+            eng.submit(PROMPTS[1], max_new=2)
+
+    def test_submit_validates_deadline(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=1))
+        with pytest.raises(ValueError, match="deadline_s"):
+            eng.submit(PROMPTS[0], deadline_s=0.0)
+
+    def test_readmit_expired_ticket_surfaces_typed_status(self):
+        """A drained ticket whose remaining deadline budget is consumed
+        by resize downtime must NOT vanish at re-admission: readmit
+        records it on the destination engine as a typed
+        ``deadline_expired`` result carrying the oracle-prefix tokens
+        it had earned — and the ticket's deadline travels as a
+        REMAINING duration, so source and destination engines with
+        different (injected) clocks never mix clock domains."""
+        from mpi4torch_tpu.elastic import replan as E
+        params = _params(CFG)
+        t = [0.0]
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=2),
+                           clock=lambda: t[0])
+        eng.submit(PROMPTS[0], max_new=BUDGETS[0], deadline_s=5.0)
+        eng.step()      # decodes >= 1 token; deadline still live
+        t[0] = 1.0
+        tickets, results = E.drain_tickets(eng)
+        assert tickets[0].deadline_s == pytest.approx(4.0)
+        assert tickets[0].remaining > 0
+        # Resize "downtime": the destination engine's clock domain is
+        # wildly different (default monotonic would be ~1e5 here); the
+        # relative budget makes that irrelevant — only the drained
+        # ticket's own remaining seconds count.
+        t2 = [100.0]
+        eng2 = serve.Engine(CFG, params, serve.ServeConfig(slots=2),
+                            clock=lambda: t2[0])
+        tickets[0].deadline_s = -0.5    # budget consumed by downtime
+        assert E.readmit(eng2, tickets) == []
+        assert eng2.status(0) == serve.STATUS_EXPIRED
+        stitched = E.stitched_results(eng2.run(), tickets)
+        want = oracle_tokens(CFG, params, PROMPTS[0], BUDGETS[0])
+        got = np.asarray(stitched[0])
+        assert len(PROMPTS[0]) < len(got) < len(want)
+        np.testing.assert_array_equal(got, want[:len(got)])
+        assert eng2.stats.snapshot()["deadline_expired"] == 1
+        # A live budget re-admits through the ordinary path unchanged.
+        eng3 = serve.Engine(CFG, params, serve.ServeConfig(slots=2),
+                            clock=lambda: t2[0])
+        tickets[0].deadline_s = 4.0
+        assert E.readmit(eng3, tickets) == [0]
+        np.testing.assert_array_equal(
+            np.asarray(E.stitched_results(eng3.run(), tickets)[0]), want)
+
+    def test_pop_results_drops_statuses(self):
+        t = [0.0]
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params, serve.ServeConfig(slots=1),
+                           clock=lambda: t[0])
+        eng.submit(PROMPTS[0], max_new=2, deadline_s=0.5)
+        t[0] = 1.0
+        eng.step()
+        assert eng.status(0) == serve.STATUS_EXPIRED
+        eng.pop_results()
+        assert eng.status(0) is None
+        assert eng.statuses() == {}
