@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke transport-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -189,6 +189,21 @@ ir-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.csched --smoke
+
+# CPU smoke run of the multi-process transport runtime
+# (mpi4torch_tpu.transport): bitwise thread-vs-process parity on
+# plain / deterministic / fused-bucket / q8 / reshard traffic ((3,)
+# worlds plus the (8,)->(2,4) reshard migration), one rank_death
+# matrix cell on the process backend — a REAL SIGKILL of a real
+# worker process that must still end in the attributed raise with its
+# fired-fault ledger — and one EXACT static-vs-runtime obs reconcile
+# over the process wire (child events ship to the parent aggregator
+# without loss), plus the transport registry-sync guard.  Exits
+# non-zero on any divergence.
+transport-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.transport --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

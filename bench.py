@@ -1996,6 +1996,78 @@ def _bench_schedule_synthesis(on_tpu: bool):
     }
 
 
+def _bench_transport(on_tpu: bool):
+    """Transport-runtime stanza (ISSUE 16): the first HONEST wall-clock
+    numbers for Mode B — ``process_parallel_speedup`` is thread-backend
+    wall time over process-backend wall time for a GIL-bound per-rank
+    compute step + allreduce, recorded next to the cpu_count that
+    bounds it (on a 1-core container the honest number is ~1.0; the
+    claim the repo stands behind everywhere is the DETERMINISTIC wire
+    census, which must be identical across backends and is asserted
+    here, not just reported)."""
+    import os as _os
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu import obs
+    from mpi4torch_tpu.obs.reconcile import measured_wire_table
+
+    NR, SPIN = 3, 120_000
+
+    def body(rank):
+        # Pure-Python FNV spin: holds the GIL, so rank-threads serialize
+        # and worker processes don't — the workload that makes the
+        # speedup a statement about the transport, not about numpy.
+        h = 0x811C9DC5
+        for i in range(SPIN):
+            h = ((h ^ (rank + i)) * 0x01000193) & 0xFFFFFFFF
+        x = jnp.full(256, float(h % 97), jnp.float32) * (rank + 1)
+        return np.asarray(mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM))
+
+    def timed(backend):
+        with obs.trace() as t:
+            t0 = _time.perf_counter()
+            out = mpi.run_ranks(body, NR, backend=backend)
+            dt = _time.perf_counter() - t0
+        census = measured_wire_table(t.events)
+        return dt, out, {"wire_bytes": census["wire_bytes"],
+                         "counts": census["counts"],
+                         "logical_events": census["logical_events"]}
+
+    # Warm both paths once (jit + worker-pool spawn) so the measured
+    # pass prices the steady state the pool exists to provide.
+    timed("thread")
+    timed("process")
+    t_thread, out_t, census_t = timed("thread")
+    t_process, out_p, census_p = timed("process")
+
+    for r in range(NR):
+        assert np.array_equal(out_t[r], out_p[r]), \
+            f"transport parity broke at rank {r}"
+    assert census_t == census_p, \
+        f"wire census diverged across backends: {census_t} vs {census_p}"
+
+    from mpi4torch_tpu.transport.pool import shared_pool
+    return {
+        "ranks": NR,
+        "cpu_count": _os.cpu_count(),
+        "thread_wall_s": round(t_thread, 4),
+        "process_wall_s": round(t_process, 4),
+        "process_parallel_speedup": round(t_thread / max(t_process, 1e-9),
+                                          3),
+        "wire_census": census_t,
+        "wire_census_identical": True,     # asserted above
+        "pool_workers_spawned": shared_pool().spawned_total,
+        "note": ("GIL-bound spin + allreduce; speedup is bounded by "
+                 "cpu_count and IPC overhead — ~1.0 on a 1-core box "
+                 "is the honest reading, the bitwise census is the "
+                 "portable claim"),
+    }
+
+
 def _guarded(name: str, fn, *args):
     """Run one sub-bench; on ANY failure return an error stanza instead of
     propagating (a completed earlier measurement must survive a later
@@ -2081,6 +2153,7 @@ def main() -> None:
         srv = _guarded("serve", _bench_serve, on_tpu)
         syn = _guarded("schedule_synthesis", _bench_schedule_synthesis,
                        on_tpu)
+        trn = _guarded("transport", _bench_transport, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -2121,6 +2194,7 @@ def main() -> None:
             "elastic": ela,
             "serve": srv,
             "schedule_synthesis": syn,
+            "transport": trn,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

@@ -26,7 +26,7 @@ DOC = Path(__file__).resolve().parent
 OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism", "serving",
          "compression", "fusion", "algorithms", "schedule_ir", "overlap",
-         "resilience", "reshard", "elasticity", "analysis",
+         "resilience", "reshard", "elasticity", "transport", "analysis",
          "observability", "api_reference",
          "design_tpu", "glossary"]
 

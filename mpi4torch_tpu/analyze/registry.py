@@ -36,6 +36,7 @@ __all__ = [
     "tune_problems",
     "overlap_split_phase_problems",
     "csched_problems",
+    "transport_problems",
     "standing_problems",
 ]
 
@@ -333,6 +334,24 @@ def csched_problems() -> List[str]:
     return problems
 
 
+# -------------------------------------------------------------- transport
+
+def transport_problems() -> List[str]:
+    """Transport registry sync (ISSUE 16): every backend registered in
+    ``transport.TRANSPORTS`` must be in the transport-smoke lane's
+    bitwise parity matrix (``transport.__main__.TESTED_BACKENDS``) —
+    merging a third backend without parity coverage fails ``make
+    transport-smoke`` AND ``make analyze-smoke`` structurally."""
+    from ..transport import TRANSPORTS
+    from ..transport.__main__ import TESTED_BACKENDS
+
+    return set_drift(
+        set(TRANSPORTS), set(TESTED_BACKENDS),
+        "transport registry {registered} out of sync with the "
+        "smoke-tested backend set {covered} — every registered "
+        "backend must pass the bitwise parity matrix")
+
+
 # ------------------------------------------------------------- everything
 
 def standing_problems() -> List[str]:
@@ -347,6 +366,7 @@ def standing_problems() -> List[str]:
     problems += [f"degrade: {p}" for p in degrade_problems()]
     problems += [f"reshard: {p}" for p in reshard_step_problems()]
     problems += [f"csched: {p}" for p in csched_problems()]
+    problems += [f"transport: {p}" for p in transport_problems()]
     from ..serve.__main__ import PARITY_POLICIES
     problems += [f"serve: {p}"
                  for p in serve_policy_problems(PARITY_POLICIES)]

@@ -31,6 +31,7 @@ jit cache on it.
 
 from __future__ import annotations
 
+import os
 import threading
 from contextlib import contextmanager
 
@@ -679,6 +680,129 @@ def set_fault_plan(plan) -> None:
     from .resilience.faults import as_plan
 
     _fault_plan = as_plan(plan)
+
+
+# ---------------------------------------------------------------------------
+# Mode B transport backend (mpi4torch_tpu.transport; ISSUE 16)
+# ---------------------------------------------------------------------------
+
+# Which registered transport serves run_ranks when no explicit
+# ``backend=`` is passed: "thread" (N rank-threads in this process —
+# the historical semantics and the tier-1 default) or "process" (N
+# spawned worker processes over the pickle-framed socket wire — real
+# parallelism, real SIGKILLs).  PROCESS-wide like the fault plan: the
+# transport choice must be visible wherever run_ranks is called.
+# Deliberately NOT part of thresholds_fingerprint(): the knob is Mode B
+# (rendezvous wire) only and provably never moves a Mode A lowering —
+# the _comm_wire_checksum precedent.
+_comm_transport = os.environ.get("MPI4TORCH_TPU_TRANSPORT", "thread")
+
+
+def comm_transport() -> str:
+    """The default transport backend :func:`~mpi4torch_tpu.run_ranks`
+    uses when no explicit ``backend=`` is passed (see
+    :mod:`mpi4torch_tpu.transport`).  Initialized from the
+    ``MPI4TORCH_TPU_TRANSPORT`` environment variable (``"thread"``
+    when unset)."""
+    return _comm_transport
+
+
+def set_comm_transport(name) -> None:
+    """Set the process-wide default transport backend (a name
+    registered in :data:`mpi4torch_tpu.transport.TRANSPORTS`)."""
+    global _comm_transport
+    if name is None:
+        name = "thread"
+    from .transport import TRANSPORTS
+
+    if name not in TRANSPORTS:
+        raise ValueError(
+            f"comm_transport must be one of {sorted(TRANSPORTS)}, got "
+            f"{name!r}")
+    _comm_transport = name
+
+
+@contextmanager
+def transport_scope(name):
+    """Install a transport default for a ``with`` block (process-wide
+    like :func:`set_fault_plan` — the choice must be visible to
+    whatever thread calls ``run_ranks`` inside the block)::
+
+        with mpi.config.transport_scope("process"):
+            mpi.run_ranks(step, 8)      # real worker processes
+    """
+    global _comm_transport
+    prev = _comm_transport
+    set_comm_transport(name)
+    try:
+        yield
+    finally:
+        _comm_transport = prev
+
+
+# Process-wide knobs a transport worker process must replicate so the
+# rank body computes bit-identically to a rank-thread.  Thread-SCOPED
+# state (deterministic_mode, compression_scope, ...) is deliberately
+# absent: rank-threads spawned by run_ranks never see the launcher
+# thread's scopes either, so shipping them would DIVERGE from the
+# thread backend, not match it.
+def snapshot_process_state() -> dict:
+    """Picklable snapshot of every process-wide config knob that
+    affects Mode B rank-body execution — what the process transport
+    ships to its workers (mpi4torch_tpu.transport).  Codecs travel by
+    registered name (an unregistered ad-hoc codec object travels as
+    itself and must pickle)."""
+    codec = _process_default
+    if codec is not None:
+        name = getattr(codec, "name", None)
+        if name is not None:
+            codec = name
+    return {
+        "compression": codec,
+        "bucket_bytes": _process_bucket_bytes,
+        "overlap": _process_overlap,
+        "algorithm": _process_algorithm,
+        "ordered_fold_gather_max_bytes": _ordered_fold_gather_max_bytes,
+        "ordered_ring_chunk_bytes": _ordered_ring_chunk_bytes,
+        "bcast_tree_max_bytes": _bcast_tree_max_bytes,
+        "latency_crossover_bytes": _latency_crossover_bytes,
+        "bandwidth_crossover_bytes": _bandwidth_crossover_bytes,
+        "phase_pipelined_ring": _phase_pipelined_ring,
+        "hier_group_size": _hier_group_size,
+        "chain_unroll_max": _chain_unroll_max,
+        "quant_hop_impl": _quant_hop_impl,
+        "serve_decode_buckets": _serve_decode_buckets,
+        "reshard_strategy": _reshard_strategy,
+        "comm_retries": _comm_retries,
+        "comm_backoff": _comm_backoff,
+        "comm_finite_guard": _comm_finite_guard,
+        "comm_wire_checksum": _comm_wire_checksum,
+    }
+
+
+def apply_process_state(state: dict) -> None:
+    """Apply a :func:`snapshot_process_state` dict — the worker-process
+    half of the config shipping contract."""
+    set_default_compression(state["compression"])
+    set_default_bucket_bytes(state["bucket_bytes"])
+    set_default_overlap(state["overlap"])
+    set_default_algorithm(state["algorithm"])
+    set_ordered_fold_gather_max_bytes(
+        state["ordered_fold_gather_max_bytes"])
+    set_ordered_ring_chunk_bytes(state["ordered_ring_chunk_bytes"])
+    set_bcast_tree_max_bytes(state["bcast_tree_max_bytes"])
+    set_latency_crossover_bytes(state["latency_crossover_bytes"])
+    set_bandwidth_crossover_bytes(state["bandwidth_crossover_bytes"])
+    set_phase_pipelined_ring(state["phase_pipelined_ring"])
+    set_hier_group_size(state["hier_group_size"])
+    set_chain_unroll_max(state["chain_unroll_max"])
+    set_quant_hop_impl(state["quant_hop_impl"])
+    set_serve_decode_buckets(state["serve_decode_buckets"])
+    set_default_reshard_strategy(state["reshard_strategy"])
+    set_comm_retries(state["comm_retries"])
+    set_comm_backoff(state["comm_backoff"])
+    set_comm_finite_guard(state["comm_finite_guard"])
+    set_comm_wire_checksum(state["comm_wire_checksum"])
 
 
 # ---------------------------------------------------------------------------

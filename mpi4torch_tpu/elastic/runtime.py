@@ -165,10 +165,16 @@ class ElasticRuntime:
         for rid in list(self._dead):
             if rid not in first.alive:
                 self._dead.pop(rid)
-        if plan is not None:
-            for pos in range(cur.size):
-                if cur.alive[pos] not in first.alive:
+        for pos in range(cur.size):
+            if cur.alive[pos] not in first.alive:
+                if plan is not None:
                     plan.clear_preemption(pos)
+                else:
+                    # A REAL preemption notice (a SIGTERMed transport
+                    # worker) has no plan to clear through — consume it
+                    # from the transport board directly.
+                    from ..transport import clear_external_preemption
+                    clear_external_preemption(pos)
         return first
 
     def drain(self, replan_body, *, leaving: Sequence[int] = (),
@@ -216,10 +222,13 @@ class ElasticRuntime:
         from .. import config as _cfg
 
         plan = _cfg.fault_plan()
-        if plan is not None:
-            for pos in range(cur.size):
-                if cur.alive[pos] not in new.alive:
+        for pos in range(cur.size):
+            if cur.alive[pos] not in new.alive:
+                if plan is not None:
                     plan.clear_preemption(pos)
+                else:
+                    from ..transport import clear_external_preemption
+                    clear_external_preemption(pos)
         self._view = new
         return [r[1] for r in results]
 

@@ -31,6 +31,7 @@ the moment a chokepoint raises ``RankFailedError`` / ``DeadlockError``
 from __future__ import annotations
 
 import collections
+import dataclasses
 import itertools
 import threading
 import time
@@ -323,6 +324,57 @@ class CommTracer:
         self._append(ev)
 
     # -------------------------------------------------------------- reads
+
+    def absorb(self, world, shards: List[Optional[dict]]) -> None:
+        """Merge process-backend worker tracer dumps into THIS tracer —
+        the parent-side half of the transport's observability contract
+        (``reconcile`` over a process-backend trace must read EXACTLY
+        like a thread-backend one).
+
+        ``shards[rank]`` is the worker's shipped dump (``{"events",
+        "postmortems", "dropped"}``) or None.  Events are re-sequenced
+        into the parent's program order by their start timestamps
+        (``perf_counter`` shares one monotonic base across processes on
+        one host) under the parent's ordinal for ``world``; per-world
+        postmortems dedup-merge exactly like concurrent observers of
+        one tear do (first snapshot wins, later shards add their
+        observers and their own rank's ring tail)."""
+        ord_ = self._world_ord(world)
+        merged: List[CommEvent] = []
+        for sh in shards:
+            if not sh:
+                continue
+            self.dropped += int(sh.get("dropped") or 0)
+            merged.extend(sh.get("events") or ())
+        merged.sort(key=lambda ev: ev.t_start)
+        for ev in merged:
+            self._append(dataclasses.replace(
+                ev, seq=next(self._seq), world=ord_))
+        for sh in shards:
+            if not sh:
+                continue
+            for pm in sh.get("postmortems") or ():
+                self._absorb_postmortem(ord_, pm)
+
+    def _absorb_postmortem(self, ord_: int, pm: dict) -> None:
+        with self._lock:
+            idx = self._failed_worlds.get(ord_)
+            if idx is None:
+                pm = dict(pm)
+                pm["world"] = ord_
+                pm["tails"] = dict(pm.get("tails") or {})
+                self._failed_worlds[ord_] = len(self.postmortems)
+                self.postmortems.append(pm)
+                return
+            dst = self.postmortems[idx]
+            dst["observers"] += pm.get("observers", 1)
+            dst["observer_ranks"] = sorted(
+                set(dst["observer_ranks"])
+                | set(pm.get("observer_ranks") or ()))
+            for r, tail in (pm.get("tails") or {}).items():
+                dst["tails"][r] = tail
+            if not dst.get("failed_ranks") and pm.get("failed_ranks"):
+                dst["failed_ranks"] = pm["failed_ranks"]
 
     def events_for(self, rank: Optional[int] = None,
                    channel: Optional[str] = None) -> List[CommEvent]:

@@ -65,14 +65,21 @@ __all__ = [
 
 
 def pending_preemptions() -> Dict[int, int]:
-    """The active fault plan's preemption notice board (``{rank:
-    ops_remaining}``), or ``{}`` when no plan is installed — the
-    between-phases poll of the elastic runtime
-    (:meth:`FaultPlan.preemption_notices`)."""
+    """The merged preemption notice board (``{rank: ops_remaining}``):
+    the active fault plan's posted notices
+    (:meth:`FaultPlan.preemption_notices`) plus the transport layer's
+    EXTERNAL board — notices posted by a real ``SIGTERM`` delivered to
+    a process-backend worker.  The elastic runtime polls this between
+    phases; it cannot tell (and must not care) whether a notice came
+    from a plan spec or a real signal."""
     from .. import config as _cfg
+    from ..transport import external_preemptions
 
     plan = _cfg.fault_plan()
-    return plan.preemption_notices() if plan is not None else {}
+    out = dict(plan.preemption_notices()) if plan is not None else {}
+    for rank, grace in external_preemptions().items():
+        out.setdefault(rank, grace)
+    return out
 
 
 @dataclass(frozen=True)
@@ -317,9 +324,32 @@ class FaultPlan:
     def clear_preemption(self, rank: int) -> None:
         """Drop ``rank``'s notice — the elastic runtime calls this once
         the rank has been drained out of the world (its death op will
-        never execute; a stale board entry would re-trigger the drain)."""
+        never execute; a stale board entry would re-trigger the drain).
+        Clears the transport layer's external (real-SIGTERM) board for
+        the rank too: the drain consumed whichever notice triggered
+        it."""
         with self._lock:
             self._preempt_death_at.pop(rank, None)
+        from ..transport import clear_external_preemption
+        clear_external_preemption(rank)
+
+    def absorb_remote(self, rank: int, dump: dict) -> None:
+        """Merge a process-backend worker's plan epilogue back into this
+        (parent) plan: ``rank``'s fired-fault ledger entries, its
+        per-(spec, rank) call counters, and any preemption notice it
+        posted.  Only ``rank``'s OWN keys move — each rank advances
+        nothing but its own counters, so per-rank merges commute and
+        the merged plan reads exactly as if the hooks had run in
+        process (``fired_kinds`` parity is matrix-asserted)."""
+        with self._lock:
+            for key, n in (dump.get("counts") or {}).items():
+                if key[1] == rank:
+                    self._counts[key] = max(self._counts.get(key, 0), n)
+            self.fired.extend(f for f in (dump.get("fired") or ())
+                              if f.rank == rank)
+            for r, v in (dump.get("notices") or {}).items():
+                if r == rank:
+                    self._preempt_death_at[r] = tuple(v)
 
     def wants_checkpoint(self) -> bool:
         """Cheap pre-check for the checkpoint layer: does any spec

@@ -254,9 +254,17 @@ def _baseline(subsystem: str, kind: str, nranks: int,
 
 
 def run_cell(kind: str, subsystem: str, nranks: int = 3,
-             algorithm: Optional[str] = None) -> dict:
+             algorithm: Optional[str] = None,
+             backend: Optional[str] = None) -> dict:
     """Run one matrix cell; returns a verdict record with ``status``
-    ``"ok"`` or ``"fail"`` and a human-readable ``detail``."""
+    ``"ok"`` or ``"fail"`` and a human-readable ``detail``.
+
+    ``backend`` selects the transport the FAULTED run executes on
+    (``None`` = the configured default, i.e. threads).  The fault-free
+    baseline always comes from the thread backend's cache, so a
+    ``backend="process"`` cell asserts recovery/inertness results
+    bitwise ACROSS backends, and its ``rank_death``/``preempt`` kills
+    are real SIGKILLs of real worker processes."""
     import mpi4torch_tpu as mpi
 
     expected = COVERAGE.get(kind, {}).get(subsystem)
@@ -281,12 +289,14 @@ def run_cell(kind: str, subsystem: str, nranks: int = 3,
     got, err = None, None
     with _knob(**knobs), fault_scope([spec]) as plan:
         try:
-            got = mpi.run_ranks(fn, nranks, timeout=CELL_TIMEOUT_S)
+            got = mpi.run_ranks(fn, nranks, timeout=CELL_TIMEOUT_S,
+                                backend=backend)
         except Exception as e:  # noqa: BLE001 — classified below
             err = e
 
     rec = {"kind": kind, "subsystem": subsystem, "nranks": nranks,
            "algorithm": algorithm, "expected": expected,
+           "backend": backend or "thread",
            "fired": sorted(plan.fired_kinds())}
 
     def fail(detail):

@@ -66,6 +66,12 @@ class DeadlockError(CommError):
         self.missing: Optional[FrozenSet[int]] = (
             None if missing is None else frozenset(missing))
 
+    def __reduce__(self):
+        # Attribution must survive the process-transport wire
+        # (mpi4torch_tpu.transport): default pickling replays only
+        # args[0] through __init__, silently dropping arrived/missing.
+        return (DeadlockError, (str(self), self.arrived, self.missing))
+
 
 class RankFailedError(CommError):
     """Raised when a rank is known to have *died* (preemption, injected
@@ -79,6 +85,10 @@ class RankFailedError(CommError):
         super().__init__(message)
         self.ranks: FrozenSet[int] = frozenset(ranks)
 
+    def __reduce__(self):
+        # Rank attribution must survive the process-transport wire.
+        return (RankFailedError, (str(self), self.ranks))
+
 
 class IntegrityError(CommError):
     """Raised when a payload fails an integrity guard — a non-finite
@@ -91,6 +101,10 @@ class IntegrityError(CommError):
     def __init__(self, message: str, ranks=()):
         super().__init__(message)
         self.ranks: FrozenSet[int] = frozenset(ranks)
+
+    def __reduce__(self):
+        # Rank attribution must survive the process-transport wire.
+        return (IntegrityError, (str(self), self.ranks))
 
 
 class InPlaceReuseError(CommError):
@@ -474,22 +488,36 @@ class World:
             # the rendezvous (plain, fused buckets, compressed wire,
             # split-phase starts) shares one censused fault surface.
             payload = plan.on_exchange(self, rank, signature, payload)
+        return self._exchange_wire(rank, signature, payload, meter)
+
+    def _exchange_wire(self, rank: int, signature: Tuple, payload: Any,
+                       meter) -> List[Any]:
+        """The rendezvous WIRE: everything below the chokepoint's tracer
+        wrapper and fault hook.  The transport seam
+        (mpi4torch_tpu.transport): a transport backend replaces only
+        this method (and the p2p/health wire siblings), so the
+        chokepoint discipline — tracing, fault injection, retry
+        accounting — is INHERITED code on every backend, never
+        re-implemented per transport."""
         self._sigs[rank] = signature
         self._slots[rank] = payload
         self._wait_barrier(rank, meter)
-        sig0 = self._sigs[0]
-        if any(s != sig0 for s in self._sigs):
-            err = CollectiveMismatchError(
-                "ranks disagree on the collective being executed: "
-                + "; ".join(f"rank {i}: {s}" for i, s in enumerate(self._sigs))
-            )
-            # Everyone observes the same mismatch => everyone raises; no need
-            # to abort the barrier.
-            raise err
+        self._check_sig_agreement(self._sigs)
         out = list(self._slots)
         # all readers done before slots are reused
         self._wait_barrier(rank, meter)
         return out
+
+    @staticmethod
+    def _check_sig_agreement(sigs) -> None:
+        sig0 = sigs[0]
+        if any(s != sig0 for s in sigs):
+            # Everyone observes the same mismatch => everyone raises; no
+            # need to abort the barrier.
+            raise CollectiveMismatchError(
+                "ranks disagree on the collective being executed: "
+                + "; ".join(f"rank {i}: {s}" for i, s in enumerate(sigs))
+            )
 
     def barrier(self, rank: int) -> None:
         self.exchange(rank, ("Barrier",), None)
@@ -597,19 +625,24 @@ class World:
         timeout = self.timeout if timeout is None else float(timeout)
         everyone = frozenset(range(self.size))
         t0 = time.monotonic()
+        ok, arrived, arrive_t = self._health_wire(rank, timeout)
+        return self._health_report(ok, arrived, everyone, t0, arrive_t)
+
+    def _health_wire(self, rank: int, timeout: float):
+        """The health-probe WIRE (transport seam — see
+        :meth:`_exchange_wire`): returns ``(ok, arrived, arrive_t)``
+        from one resettable-barrier probe round."""
+        everyone = frozenset(range(self.size))
         arrivals: List[Dict[int, float]] = []
         try:
             self._health.wait(rank, timeout, retries=0, backoff=0.0,
                               collect_arrivals=arrivals)
         except _BarrierTimeout as t:
-            return self._health_report(False, t.arrived, everyone, t0,
-                                       t.arrive_t)
+            return False, t.arrived, t.arrive_t
         except _BarrierBroken as b:
             arrived = frozenset() if b.arrived is None else b.arrived
-            return self._health_report(False, arrived, everyone, t0,
-                                       b.arrive_t)
-        return self._health_report(True, everyone, everyone, t0,
-                                   arrivals[0] if arrivals else {})
+            return False, arrived, b.arrive_t
+        return True, everyone, arrivals[0] if arrivals else {}
 
     def _health_report(self, ok: bool, arrived: FrozenSet[int],
                        everyone: FrozenSet[int], t0: float,
@@ -678,8 +711,23 @@ class World:
             # self._dropped for retry-triggered redelivery).
             payload = plan.on_p2p_send(self, src, dst, tag, payload)
             if payload is _P2P_DROPPED:
+                # The stash already happened inside the plan hook
+                # (world._dropped); a remote transport relocates it to
+                # wherever its receiver-side redelivery lives.
+                self._on_wire_drop(src, dst, tag)
                 return
+        self._p2p_send_wire(src, dst, tag, payload)
+
+    def _p2p_send_wire(self, src: int, dst: int, tag: int,
+                       payload: Any) -> None:
+        """The p2p send WIRE (transport seam — see
+        :meth:`_exchange_wire`)."""
         self._mailbox(src, dst, tag).put(payload)
+
+    def _on_wire_drop(self, src: int, dst: int, tag: int) -> None:
+        """Transport hook after a fault-injected drop: on the thread
+        backend the dropped payload already sits in ``self._dropped``
+        where the receiver's retry redelivers from — nothing to do."""
 
     def p2p_recv(self, src: int, dst: int, tag: int) -> Any:
         """Blocking receive with deadlock timeout (analogue of MPI_Irecv+Wait,
@@ -708,6 +756,12 @@ class World:
     def _p2p_recv(self, src: int, dst: int, tag: int, meter) -> Any:
         if not (0 <= src < self.size):
             raise CommError(f"invalid source rank {src} (size {self.size})")
+        return self._p2p_recv_wire(src, dst, tag, meter)
+
+    def _p2p_recv_wire(self, src: int, dst: int, tag: int, meter) -> Any:
+        """The p2p receive WIRE (transport seam — see
+        :meth:`_exchange_wire`): the blocking wait, the retry/backoff
+        patience windows, and the dropped-message redelivery."""
         q = self._mailbox(src, dst, tag)
         retries = _cfg.comm_retries()
         backoff = _cfg.comm_backoff()
@@ -869,15 +923,69 @@ def effective_rank_context() -> RankContext:
     return ctx if ctx is not None else _default_ctx
 
 
+def _fn_nparams(fn: Callable) -> int:
+    """How many required positional parameters ``fn`` takes — decides
+    the ``fn()`` vs ``fn(rank)`` calling convention of :func:`run_ranks`
+    (shared with the transport backends, which must apply the SAME
+    convention in a worker process)."""
+    import inspect
+
+    try:
+        return len([
+            p for p in inspect.signature(fn).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.default is p.empty
+        ])
+    except (TypeError, ValueError):
+        return 0
+
+
+def _raise_primary(errors: List[Optional[BaseException]],
+                   first_error: Optional[BaseException]) -> None:
+    """Re-raise the root-cause per-rank error with the other ranks'
+    failures attached as a PEP-678 note — ONE rule for the thread
+    backend and the process transport, so a failed run reads the same
+    on every backend."""
+    failed = [(r, e) for r, e in enumerate(errors) if e is not None]
+    if not failed:
+        return
+    # Prefer the root-cause error over secondary abort noise, and attach
+    # the other ranks' failures as context.
+    primary = first_error
+    if primary is None or primary not in errors:
+        primary = failed[0][1]
+    secondary = [(r, e) for r, e in failed if e is not primary]
+    if secondary:
+        note = ("other rank failures: "
+                + "; ".join(f"rank {r}: {type(e).__name__}: {e}"
+                            for r, e in secondary))
+        if hasattr(primary, "add_note"):    # PEP 678, Python >= 3.11
+            primary.add_note(note)
+        else:
+            # 3.10: stash where debuggers can see it; tracebacks
+            # render the primary error unchanged.
+            primary.__notes__ = getattr(primary, "__notes__", []) + [note]
+    raise primary
+
+
 def run_ranks(fn: Callable, nranks: int, timeout: Optional[float] = None,
-              return_results: bool = True) -> List[Any]:
-    """Run ``fn`` on ``nranks`` rank-threads — the `mpirun -np N` analogue.
+              return_results: bool = True,
+              backend: Optional[str] = None) -> List[Any]:
+    """Run ``fn`` on ``nranks`` ranks — the `mpirun -np N` analogue.
 
     ``fn`` is called either as ``fn()`` or ``fn(rank)`` (if it accepts one
     positional argument).  Inside, ``mpi4torch_tpu.COMM_WORLD`` resolves to
     this world with a concrete Python-int rank, so reference-style per-rank
     scripts (rank-conditional shapes and asserts) run unmodified in spirit
     (SURVEY.md §4 'What the rebuild needs').
+
+    ``backend`` selects the transport (mpi4torch_tpu.transport):
+    ``"thread"`` — N rank-threads in this process, the historical
+    semantics and the default; ``"process"`` — N spawned worker
+    processes over the pickle-framed socket transport (real parallelism,
+    real SIGKILLs).  ``None`` defers to ``config.comm_transport()``
+    (itself defaulting to the ``MPI4TORCH_TPU_TRANSPORT`` environment
+    variable, else ``"thread"``).
 
     ``timeout`` is the world's deadlock-detection wall clock;  ``None``
     (default) defers to ``World``'s own default, i.e. the
@@ -889,20 +997,17 @@ def run_ranks(fn: Callable, nranks: int, timeout: Optional[float] = None,
     after all threads have been reaped; other ranks' failures are attached
     as context.
     """
-    import inspect
+    name = backend if backend is not None else _cfg.comm_transport()
+    if name != "thread":
+        from .transport import get_transport
+
+        return get_transport(name).run_ranks(
+            fn, nranks, timeout=timeout, return_results=return_results)
 
     world = World(nranks, timeout=timeout)
     results: List[Any] = [None] * nranks
     errors: List[Optional[BaseException]] = [None] * nranks
-
-    try:
-        nparams = len([
-            p for p in inspect.signature(fn).parameters.values()
-            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
-            and p.default is p.empty
-        ])
-    except (TypeError, ValueError):
-        nparams = 0
+    nparams = _fn_nparams(fn)
 
     def worker(rank: int):
         with _bind_rank(RankContext(world, rank)):
@@ -928,23 +1033,5 @@ def run_ranks(fn: Callable, nranks: int, timeout: Optional[float] = None,
     for t in threads:
         t.join()
 
-    failed = [(r, e) for r, e in enumerate(errors) if e is not None]
-    if failed:
-        # Prefer the root-cause error over secondary abort noise, and attach
-        # the other ranks' failures as context.
-        primary = world._first_error
-        if primary is None or primary not in errors:
-            primary = failed[0][1]
-        secondary = [(r, e) for r, e in failed if e is not primary]
-        if secondary:
-            note = ("other rank failures: "
-                    + "; ".join(f"rank {r}: {type(e).__name__}: {e}"
-                                for r, e in secondary))
-            if hasattr(primary, "add_note"):    # PEP 678, Python >= 3.11
-                primary.add_note(note)
-            else:
-                # 3.10: stash where debuggers can see it; tracebacks
-                # render the primary error unchanged.
-                primary.__notes__ = getattr(primary, "__notes__", []) + [note]
-        raise primary
+    _raise_primary(errors, world._first_error)
     return results if return_results else []

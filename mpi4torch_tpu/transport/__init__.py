@@ -1,0 +1,117 @@
+"""mpi4torch_tpu.transport — the Mode B transport runtime.
+
+One registry, two backends:
+
+* ``thread`` — N rank-threads in the launcher process (the historical
+  semantics and the tier-1 default; thread.py delegates to the same
+  code object ``runtime.run_ranks`` always ran);
+* ``process`` — N pooled worker processes over a pickle-framed socket
+  wire (process.py): real parallelism, real SIGKILLs, real SIGTERMs —
+  and the SAME chokepoint discipline, bitwise results, and attributed
+  failures (base.py states the contract).
+
+Selection: ``run_ranks(..., backend=...)`` per call, or
+``config.set_comm_transport`` / ``config.transport_scope`` /
+``MPI4TORCH_TPU_TRANSPORT`` process-wide.
+
+The module also owns the **external preemption board**: a worker that
+receives a REAL ``SIGTERM`` piggybacks the notice on its next frame and
+the parent records it here; ``resilience.pending_preemptions`` merges
+this board with the fault plan's, so the elastic runtime drains a
+really-preempted rank through exactly the code path a fault-injected
+notice exercises.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Type
+
+from .base import Transport
+from .process import ProcessTransport
+from .thread import ThreadTransport
+
+__all__ = [
+    "Transport",
+    "TRANSPORTS",
+    "register_transport",
+    "get_transport",
+    "available_transports",
+    "external_preemptions",
+    "note_external_preemption",
+    "clear_external_preemption",
+    "shutdown",
+]
+
+TRANSPORTS: Dict[str, Type[Transport]] = {}
+_instances: Dict[str, Transport] = {}
+_inst_lock = threading.Lock()
+
+
+def register_transport(cls: Type[Transport]) -> Type[Transport]:
+    """Register a Transport subclass under ``cls.name`` (idempotent for
+    the same class; refuses silent shadowing)."""
+    have = TRANSPORTS.get(cls.name)
+    if have is not None and have is not cls:
+        raise ValueError(
+            f"transport {cls.name!r} already registered by "
+            f"{have.__module__}.{have.__qualname__}")
+    TRANSPORTS[cls.name] = cls
+    return cls
+
+
+def get_transport(name: str) -> Transport:
+    """The (singleton) backend instance for ``name``."""
+    cls = TRANSPORTS.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown transport {name!r}; registered: "
+            f"{sorted(TRANSPORTS)}")
+    with _inst_lock:
+        inst = _instances.get(name)
+        if inst is None:
+            inst = _instances[name] = cls()
+        return inst
+
+
+def available_transports():
+    return sorted(TRANSPORTS)
+
+
+def shutdown() -> None:
+    """Release every backend's long-lived resources (the worker pool)."""
+    with _inst_lock:
+        insts = list(_instances.values())
+    for inst in insts:
+        inst.shutdown()
+    from .pool import shutdown_shared_pool
+    shutdown_shared_pool()
+
+
+register_transport(ThreadTransport)
+register_transport(ProcessTransport)
+
+
+# ------------------------------------------------ external preemptions
+
+_ext_lock = threading.Lock()
+_ext_preempt: Dict[int, int] = {}
+
+
+def note_external_preemption(rank: int, grace: int) -> None:
+    """Record a REAL preemption notice (a worker's SIGTERM) for a rank
+    position.  The board outlives the run — the elastic runtime polls
+    between phases, exactly like a fault plan's notice board."""
+    with _ext_lock:
+        _ext_preempt[rank] = int(grace)
+
+
+def external_preemptions() -> Dict[int, int]:
+    with _ext_lock:
+        return dict(_ext_preempt)
+
+
+def clear_external_preemption(rank: int) -> None:
+    """Consume a notice once the rank is drained out of the world."""
+    with _ext_lock:
+        _ext_preempt.pop(rank, None)

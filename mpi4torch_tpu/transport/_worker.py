@@ -1,0 +1,313 @@
+"""Transport worker: one pooled process, one rank body at a time.
+
+Launched as ``python -m mpi4torch_tpu.transport._worker <socket>`` by
+the pool (pool.py).  The worker connects back, says ``hello`` with its
+PID, then loops on ``run`` frames: rebuild the shipped state (config
+snapshot, fault plan specs+counters, a fresh tracer), run the rank body
+on the MAIN thread against a :class:`_ProcessWorld` whose ``*_wire``
+seams are blocking request/reply frames to the parent's switchboard,
+and answer with a ``done`` frame carrying the result and the epilogue
+(fired faults, counters, preemption notices, CommEvents, postmortems).
+
+Two signals are REAL here, not simulated:
+
+* a fault-injected ``rank_death``/``preempt`` death reaches
+  :meth:`_ProcessWorld.mark_dead` for the worker's own rank, which
+  ships a best-effort ``dying`` frame (the evidence: error + epilogue)
+  and then ``SIGKILL``\\ s its own process — survivors attribute a rank
+  that is actually gone;
+* ``SIGTERM`` is the preemption notice: a handler latches it, the next
+  frame to the parent piggybacks it, and the elastic runtime sees it
+  on the same notice board a fault plan posts to.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import sys
+import time
+from typing import Any, Optional
+
+from .wire import WireError, recv_frame, send_frame
+
+_DEFAULT_PREEMPT_GRACE = int(os.environ.get(
+    "MPI4TORCH_TPU_PREEMPT_GRACE", "64"))
+
+# SIGTERM latch: {"grace": int} once a preemption notice arrived and has
+# not yet been reported to the parent.
+_PREEMPT: dict = {}
+
+
+def _on_sigterm(signum, frame):
+    _PREEMPT["grace"] = _DEFAULT_PREEMPT_GRACE
+
+
+def _sanitize_error(err: BaseException) -> BaseException:
+    """An error must survive the wire: try pickling it as-is; fall back
+    to a same-attribution CommError when it carries unpicklable
+    baggage."""
+    import pickle
+
+    try:
+        pickle.loads(pickle.dumps(err, protocol=pickle.HIGHEST_PROTOCOL))
+        return err
+    except Exception:
+        from ..runtime import CommError
+        return CommError(f"{type(err).__name__}: {err}")
+
+
+class _Client:
+    """The child side of the wire: blocking request/reply ops plus
+    fire-and-forget casts, all on the worker's one socket (the body
+    runs on the main thread — there is never a concurrent reader)."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def _stamp(self, frame: dict) -> dict:
+        frame["kind"] = "op"
+        grace = _PREEMPT.pop("grace", None)
+        if grace is not None:
+            frame["preempt"] = grace
+        return frame
+
+    def call(self, frame: dict) -> dict:
+        send_frame(self._sock, self._stamp(frame))
+        rep = recv_frame(self._sock)
+        if rep is None:
+            raise WireError("transport parent closed the connection")
+        return rep
+
+    def cast(self, frame: dict) -> None:
+        send_frame(self._sock, self._stamp(frame))
+
+    def send_raw(self, frame: dict) -> None:
+        send_frame(self._sock, frame)
+
+
+def _make_world(size: int, rank: int, timeout: float, client: _Client,
+                epilogue_cb):
+    """Build the worker's World subclass (deferred import: the runtime
+    pulls in jax; the pool wants ``hello`` out before anything heavy)."""
+    from .. import config as _cfg
+    from .. import runtime as _rt
+
+    class _ProcessWorld(_rt.World):
+        """A World whose wire is the parent switchboard."""
+
+        def __init__(self):
+            super().__init__(size, timeout=timeout)
+            self._rank = rank
+            self._client = client
+
+        # ------------------------------------------------- wire seams
+
+        def _exchange_wire(self, r, signature, payload, meter):
+            t0 = time.perf_counter()
+            rep = self._client.call({
+                "op": "exchange", "rank": r, "signature": signature,
+                "payload": payload, "timeout": self.timeout,
+                "retries": _cfg.comm_retries(),
+                "backoff": _cfg.comm_backoff()})
+            if not rep["ok"]:
+                self._apply_remote_failure(rep["error"])
+                raise rep["error"]
+            if meter is not None:
+                meter.add_wait(time.perf_counter() - t0)
+            if rep.get("retries_used"):
+                self._count_retries(rep["retries_used"], meter)
+            self._sigs = list(rep["sigs"])
+            self._slots = list(rep["payloads"])
+            self._check_sig_agreement(self._sigs)
+            return list(self._slots)
+
+        def _p2p_send_wire(self, src, dst, tag, payload):
+            self._client.cast({"op": "p2p_send", "rank": self._rank,
+                               "src": src, "dst": dst, "tag": tag,
+                               "payload": payload})
+
+        def _on_wire_drop(self, src, dst, tag):
+            # The fault hook stashed the dropped payload in OUR
+            # _dropped, but redelivery happens at the receiver — move
+            # the stash to the parent's switchboard.
+            with self._mb_lock:
+                stash = self._dropped.get((src, dst, tag))
+                payload = stash.pop() if stash else None
+            self._client.cast({"op": "drop_stash", "rank": self._rank,
+                               "src": src, "dst": dst, "tag": tag,
+                               "payload": payload})
+
+        def _p2p_recv_wire(self, src, dst, tag, meter):
+            rep = self._client.call({
+                "op": "p2p_recv", "rank": self._rank, "src": src,
+                "dst": dst, "tag": tag, "timeout": self.timeout,
+                "retries": _cfg.comm_retries(),
+                "backoff": _cfg.comm_backoff()})
+            if not rep["ok"]:
+                self._apply_remote_failure(rep["error"])
+                raise rep["error"]
+            if rep.get("retries_used"):
+                self._count_retries(rep["retries_used"], meter)
+            return rep["payload"]
+
+        def _health_wire(self, r, probe_timeout):
+            rep = self._client.call({"op": "health", "rank": r,
+                                     "timeout": probe_timeout})
+            return (rep["healthy"], frozenset(rep["arrived"]),
+                    dict(rep["arrive_t"]))
+
+        # ---------------------------------------------- failure paths
+
+        def _apply_remote_failure(self, err):
+            """Latch world-level failure state locally so follow-up ops
+            fail fast with the inherited ``_check_failed`` attribution
+            (the thread backend's shared-world equivalent)."""
+            if isinstance(err, _rt.RankFailedError) and err.ranks:
+                for r in err.ranks:
+                    if r != self._rank:
+                        self._dead.setdefault(r, err)
+                with self._err_lock:
+                    if self._first_error is None:
+                        self._first_error = err
+                self._failed.set()
+            elif type(err) is _rt.CommError:
+                # The bare-CommError replies are the world-level aborts;
+                # typed subclasses (mismatch, deadlock) are per-round
+                # and must NOT latch (thread parity).
+                with self._err_lock:
+                    if self._first_error is None:
+                        self._first_error = err
+                self._failed.set()
+
+        def mark_dead(self, r, exc):
+            if r != self._rank:
+                return super().mark_dead(r, exc)
+            # A fault killed THIS rank: perform the reaper's
+            # flight-recorder duty now (after SIGKILL there is no one
+            # left to do it), ship the evidence, then actually die.
+            try:
+                tracer = _cfg.comm_tracer()
+                if tracer is not None:
+                    tracer.note_rank_failure(self, r, exc)
+                self._client.send_raw({
+                    "kind": "dying", "rank": r,
+                    "error": _sanitize_error(exc),
+                    "epilogue": epilogue_cb()})
+            except Exception:
+                pass   # the EOF after SIGKILL still attributes us
+            finally:
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    return _ProcessWorld()
+
+
+def _epilogue(rank: int) -> dict:
+    from .. import config as _cfg
+
+    ep: dict = {"preempt": _PREEMPT.pop("grace", None)}
+    plan = _cfg.fault_plan()
+    if plan is not None:
+        with plan._lock:
+            ep["plan"] = {
+                "fired": list(plan.fired),
+                "counts": {k: v for k, v in plan._counts.items()
+                           if k[1] == rank},
+                "notices": {r: v for r, v in
+                            plan._preempt_death_at.items() if r == rank},
+            }
+    tracer = _cfg.comm_tracer()
+    if tracer is not None:
+        ep["trace"] = {"events": list(tracer.events),
+                       "postmortems": list(tracer.postmortems),
+                       "dropped": tracer.dropped}
+    return ep
+
+
+def _run(client: _Client, f: dict) -> None:
+    from .. import config as _cfg
+    from .. import runtime as _rt
+    from . import _ship
+
+    rank, size = f["rank"], f["size"]
+    # The shipped process-wide knobs; thread-scoped launcher state
+    # (deterministic-mode scopes) is deliberately NOT shipped — a
+    # rank-thread would not see it either.
+    _cfg.apply_process_state(f["config"])
+    # A worker never recurses into the process backend: its own
+    # run_ranks calls (none expected) stay on threads.
+    _cfg.set_comm_transport("thread")
+    plan = None
+    if f["plan"] is not None:
+        from ..resilience.faults import FaultPlan
+        plan = FaultPlan(f["plan"]["specs"])
+        plan._counts.update(f["plan"]["counts"])
+    _cfg.set_fault_plan(plan)
+    tracer = None
+    if f["trace"] is not None:
+        from ..obs.trace import CommTracer
+        tracer = CommTracer(ring=f["trace"]["ring"])
+    _cfg.set_comm_tracer(tracer)
+
+    world = _make_world(size, rank, f["timeout"], client,
+                        lambda: _epilogue(rank))
+    fn = _ship.loads(f["fn"])
+    nparams = f["nparams"]
+    result, error = None, None
+    with _rt._bind_rank(_rt.RankContext(world, rank)):
+        try:
+            result = fn(rank) if nparams >= 1 else fn()
+        except BaseException as e:   # noqa: BLE001 — reported to parent
+            error = e
+            if tracer is not None:
+                # The worker-side half of run_ranks' reaper: attribute
+                # into the local flight recorder, so the shipped
+                # postmortem carries this rank's ring tail.
+                tracer.note_rank_failure(world, rank, e)
+    ep = _epilogue(rank)
+    try:
+        if error is None:
+            client.send_raw({"kind": "done", "rank": rank, "ok": True,
+                             "result": result, "epilogue": ep})
+        else:
+            client.send_raw({"kind": "done", "rank": rank, "ok": False,
+                             "error": _sanitize_error(error),
+                             "epilogue": ep})
+    except Exception:
+        # Unpicklable result: still answer, or the parent reads our
+        # silence as a death.
+        client.send_raw({
+            "kind": "done", "rank": rank, "ok": False, "epilogue": ep,
+            "error": _rt.CommError(
+                f"rank {rank} result could not cross the transport "
+                "wire (unpicklable)")})
+    finally:
+        _cfg.set_fault_plan(None)
+        _cfg.set_comm_tracer(None)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv if argv is None else argv
+    addr = argv[1]
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(addr)
+    send_frame(sock, {"kind": "hello", "pid": os.getpid()})
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    client = _Client(sock)
+    # Pre-warm the heavy imports while the pool is still idle, so the
+    # FIRST run frame does not pay them.
+    import mpi4torch_tpu   # noqa: F401
+    while True:
+        try:
+            f = recv_frame(sock)
+        except WireError:
+            return 1
+        if f is None or f.get("kind") == "shutdown":
+            return 0
+        if f.get("kind") == "run":
+            _run(client, f)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

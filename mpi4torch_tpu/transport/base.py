@@ -1,0 +1,47 @@
+"""The transport abstraction: what a Mode B backend must provide.
+
+A transport executes one :func:`mpi4torch_tpu.run_ranks` call — N rank
+bodies against one logical world — and owes the caller the SAME
+observable contract the historical thread runtime established:
+
+* **The two chokepoints stay THE chokepoints.**  Every rank body's
+  communication funnels through ``World.exchange`` and
+  ``World.p2p_send``/``p2p_recv`` (runtime.py), whose tracer wrappers
+  and fault-plan hooks are INHERITED code on every backend — a
+  transport replaces only the ``*_wire`` seams below them.  Fault
+  injection (resilience/), CommEvent tracing (obs/), and retry/backoff
+  compose over any backend with zero per-subsystem hooks.
+* **Bitwise results.**  A rank body must compute the same bits on every
+  backend: payloads cross a transport's wire losslessly, and config
+  shipping replicates exactly the process-wide knobs a rank-thread
+  would see (never the launcher's thread-scoped state, which
+  rank-threads do not see either).
+* **Attributed failures.**  A dead rank surfaces as the same typed,
+  rank-attributed :class:`~mpi4torch_tpu.RankFailedError` on every
+  survivor; a torn rendezvous as the same arrived/missing-attributed
+  :class:`~mpi4torch_tpu.DeadlockError`; the first per-rank error is
+  re-raised on the caller with the others attached as a PEP-678 note
+  (``runtime._raise_primary`` — one rule, every backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+__all__ = ["Transport"]
+
+
+class Transport:
+    """Base class of a registered Mode B transport backend."""
+
+    #: Registry name (``transport.TRANSPORTS`` key).
+    name: str = "?"
+
+    def run_ranks(self, fn: Callable, nranks: int,
+                  timeout: Optional[float] = None,
+                  return_results: bool = True) -> List[Any]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        """Release any long-lived resources (worker pools).  Idempotent;
+        the thread backend has nothing to release."""

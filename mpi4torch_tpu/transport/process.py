@@ -1,0 +1,618 @@
+"""The process transport: real worker processes behind the chokepoints.
+
+Architecture (one ``run_ranks`` call):
+
+* the parent leases N pooled workers (pool.py) and ships each a ``run``
+  frame — the rank body (by value, _ship.py), the process-wide config
+  snapshot, the fault plan's specs+counters, the tracer's ring size;
+* each worker executes the body on its main thread against a
+  ``World`` subclass whose ``*_wire`` seams forward to the parent over
+  the pickle-framed socket (wire.py) — everything ABOVE the seams
+  (tracer wrappers, fault hooks, retry accounting, signature checks) is
+  inherited runtime code, so fault injection and CommEvent tracing
+  compose over process boundaries with zero per-subsystem hooks;
+* the parent's **switchboard** is the rendezvous: it collects exchange
+  deposits and answers every rank in ONE round trip, owns the p2p
+  mailboxes/parked receives/dropped-payload stash, runs the health
+  rounds, and enforces every waiter's patience (timeout + retry
+  backoff windows) from a janitor thread — producing the SAME typed,
+  attributed errors (DeadlockError arrived/missing, RankFailedError by
+  rank) the thread backend's attributed barrier produces;
+* a per-worker **reader thread** doubles as the reaper: a ``dying``
+  frame (a fault-injected death ships its evidence, then the child
+  SIGKILLs itself) or a bare socket EOF (a REAL kill) marks the rank
+  dead, fails parked peers with the dead rank's name, and feeds the
+  parent tracer's flight recorder;
+* at the end the parent merges each worker's epilogue — fired-fault
+  ledger entries, per-rank fault counters, preemption notices,
+  CommEvents and postmortems — back into the parent's plan and tracer,
+  so ``fired_kinds``/``reconcile`` read EXACTLY as they do on threads.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import _ship
+from .base import Transport
+from .pool import Worker, shared_pool
+from .wire import WireError, recv_frame
+
+__all__ = ["ProcessTransport"]
+
+_TICK_S = 0.02
+
+
+class _XWait:
+    """One parked exchange waiter (arrival time + its patience)."""
+
+    __slots__ = ("arrival", "timeout", "retries", "backoff", "patience")
+
+    def __init__(self, arrival, timeout, retries, backoff):
+        from ..runtime import _backoff_pause
+        self.arrival = arrival
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+        self.patience = timeout + sum(
+            _backoff_pause(k, backoff, timeout)
+            for k in range(1, retries + 1))
+
+
+class _RWait:
+    """One parked p2p receive (its own retry/backoff window chain)."""
+
+    __slots__ = ("rank", "key", "timeout", "retries", "backoff",
+                 "attempt", "deadline")
+
+    def __init__(self, rank, key, timeout, retries, backoff):
+        self.rank = rank
+        self.key = key
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+        self.attempt = 0
+        self.deadline = time.monotonic() + timeout
+
+
+def _used_windows(elapsed: float, timeout: float, retries: int,
+                  backoff: float) -> int:
+    """How many retry extensions a waiter that blocked ``elapsed``
+    seconds consumed — the parent-side mirror of the attributed
+    barrier's per-waiter accounting."""
+    from ..runtime import _backoff_pause
+    if retries <= 0 or elapsed <= timeout:
+        return 0
+    acc, used = timeout, 0
+    while used < retries and elapsed > acc:
+        used += 1
+        acc += _backoff_pause(used, backoff, timeout)
+    return used
+
+
+class _Switchboard:
+    """The parent-side rendezvous state of ONE process-backend world.
+
+    Every method mutates state under one lock and returns; socket
+    writes happen OUTSIDE the lock (a reply to a blocked child can
+    never be stalled by another child's frame mid-parse).  It is also
+    the world identity the parent tracer keys postmortems on (it has a
+    ``size``, which is all ``note_rank_failure`` needs).
+    """
+
+    def __init__(self, size: int, timeout: float, workers: List[Worker],
+                 on_preempt: Optional[Callable[[int, int], None]] = None):
+        from ..runtime import (CommError, DeadlockError,          # noqa: F401
+                               RankFailedError)
+        self.size = size
+        self.timeout = timeout
+        self._workers = workers
+        self._on_preempt = on_preempt
+        self._lock = threading.Lock()
+        # exchange round
+        self._x_sigs: Dict[int, Any] = {}
+        self._x_pay: Dict[int, Any] = {}
+        self._x_wait: Dict[int, _XWait] = {}
+        self._x_broken: Optional[BaseException] = None
+        # p2p
+        self._mail: Dict[Tuple[int, int, int], List[Any]] = {}
+        self._dropped: Dict[Tuple[int, int, int], List[Any]] = {}
+        self._recv_wait: Dict[Tuple[int, int, int], List[_RWait]] = {}
+        # health round
+        self._h_arrive: Dict[int, float] = {}
+        self._h_wait: Dict[int, Tuple[float, float]] = {}
+        # failure state
+        self._dead: Dict[int, BaseException] = {}
+        self._failed = False
+        self.first_error: Optional[BaseException] = None
+
+    # -------------------------------------------------------- messaging
+
+    def _flush(self, sends: List[Tuple[int, dict]]) -> None:
+        for rank, frame in sends:
+            w = self._workers[rank]
+            try:
+                w.send(frame)
+            except OSError:
+                # The addressee died between parking and reply; its
+                # reader thread owns the attribution.
+                pass
+
+    @staticmethod
+    def _ok(rank: int, **kw) -> Tuple[int, dict]:
+        return rank, {"kind": "reply", "ok": True, **kw}
+
+    @staticmethod
+    def _err(rank: int, error: BaseException) -> Tuple[int, dict]:
+        return rank, {"kind": "reply", "ok": False, "error": error}
+
+    # ----------------------------------------------------------- errors
+    # Message text mirrors runtime.World verbatim — a survivor must read
+    # the same attribution on every backend.
+
+    def _already_failed_error(self) -> BaseException:
+        from ..runtime import CommError, RankFailedError
+        if self._dead:
+            dead = sorted(self._dead)
+            return RankFailedError(
+                f"communication world already failed: rank(s) {dead} "
+                "died (preempted or crashed)", ranks=dead)
+        return CommError(
+            "communication world already failed on another rank")
+
+    def _rank_failed_error(self, verb: str) -> BaseException:
+        from ..runtime import RankFailedError
+        dead = sorted(self._dead)
+        return RankFailedError(
+            f"collective {verb}: rank(s) {dead} failed (preempted or "
+            "crashed mid-collective)", ranks=dead)
+
+    def _deadlock_error(self, arrived) -> BaseException:
+        from ..runtime import DeadlockError
+        arrived = frozenset(arrived)
+        missing = frozenset(range(self.size)) - arrived
+        return DeadlockError(
+            f"collective rendezvous timed out after {self.timeout}s — a "
+            "rank did not reach the matching collective (the analogue of "
+            "an MPI deadlock; every rank must execute the same "
+            "communication sequence, see SURVEY.md §3.3).  Ranks "
+            f"{sorted(arrived)} arrived; ranks {sorted(missing)} did not",
+            arrived=arrived, missing=missing)
+
+    def _recv_dead_src_error(self, src, dst, tag) -> BaseException:
+        from ..runtime import RankFailedError
+        return RankFailedError(
+            f"receive (src={src}, dst={dst}, tag={tag}) cannot "
+            f"complete: rank {src} failed", ranks=(src,))
+
+    def _recv_timeout_error(self, key) -> BaseException:
+        from ..runtime import DeadlockError
+        src, dst, tag = key
+        was_dropped = bool(self._dropped.get(key))
+        return DeadlockError(
+            f"receive (src={src}, dst={dst}, tag={tag}) timed "
+            f"out after {self.timeout}s — matching send never "
+            "posted" + (
+                " (a fault-injected drop consumed the message "
+                "and config.comm_retries is exhausted/unset)"
+                if was_dropped else ""))
+
+    # --------------------------------------------------------- dispatch
+
+    def handle_op(self, f: dict) -> None:
+        grace = f.get("preempt")
+        if grace is not None and self._on_preempt is not None:
+            self._on_preempt(f["rank"], grace)
+        sends: List[Tuple[int, dict]] = []
+        with self._lock:
+            op = f["op"]
+            if op == "exchange":
+                self._op_exchange(f, sends)
+            elif op == "p2p_send":
+                self._op_send(f, sends)
+            elif op == "drop_stash":
+                key = (f["src"], f["dst"], f["tag"])
+                self._dropped.setdefault(key, []).append(f["payload"])
+            elif op == "p2p_recv":
+                self._op_recv(f, sends)
+            elif op == "health":
+                self._op_health(f, sends)
+            else:
+                from ..runtime import CommError
+                sends.append(self._err(
+                    f["rank"], CommError(f"unknown transport op {op!r}")))
+        self._flush(sends)
+
+    # --------------------------------------------------------- exchange
+
+    def _op_exchange(self, f: dict, sends) -> None:
+        r = f["rank"]
+        if self._failed:
+            sends.append(self._err(r, self._already_failed_error()))
+            return
+        if self._x_broken is not None:
+            # A peer's timeout already tore the rendezvous generation:
+            # late arrivals read the same attribution (thread backend:
+            # the permanently-broken barrier re-raises it).
+            sends.append(self._err(r, self._x_broken))
+            return
+        self._x_sigs[r] = f["signature"]
+        self._x_pay[r] = f["payload"]
+        self._x_wait[r] = _XWait(time.monotonic(), f["timeout"],
+                                 f["retries"], f["backoff"])
+        if len(self._x_wait) == self.size:
+            self._complete_exchange(sends)
+
+    def _complete_exchange(self, sends) -> None:
+        sigs = [self._x_sigs[i] for i in range(self.size)]
+        pays = [self._x_pay[i] for i in range(self.size)]
+        now = time.monotonic()
+        for r, w in self._x_wait.items():
+            used = _used_windows(now - w.arrival, w.timeout,
+                                 w.retries, w.backoff)
+            sends.append(self._ok(r, sigs=sigs, payloads=pays,
+                                  retries_used=used))
+        self._x_wait.clear()
+        self._x_sigs.clear()
+        self._x_pay.clear()
+
+    # -------------------------------------------------------------- p2p
+
+    def _op_send(self, f: dict, sends) -> None:
+        key = (f["src"], f["dst"], f["tag"])
+        parked = self._recv_wait.get(key)
+        if parked:
+            p = parked.pop(0)
+            if not parked:
+                del self._recv_wait[key]
+            sends.append(self._ok(p.rank, payload=f["payload"],
+                                  retries_used=0))
+        else:
+            self._mail.setdefault(key, []).append(f["payload"])
+
+    def _op_recv(self, f: dict, sends) -> None:
+        r = f["rank"]
+        key = (f["src"], f["dst"], f["tag"])
+        # Dead-src attribution BEFORE the generic world check — the
+        # thread backend's receive loop order.
+        if f["src"] in self._dead:
+            sends.append(self._err(
+                r, self._recv_dead_src_error(*key)))
+            return
+        if self._failed:
+            sends.append(self._err(r, self._already_failed_error()))
+            return
+        box = self._mail.get(key)
+        if box:
+            payload = box.pop(0)
+            if not box:
+                del self._mail[key]
+            sends.append(self._ok(r, payload=payload, retries_used=0))
+            return
+        self._recv_wait.setdefault(key, []).append(
+            _RWait(r, key, f["timeout"], f["retries"], f["backoff"]))
+
+    # ------------------------------------------------------------ health
+
+    def _op_health(self, f: dict, sends) -> None:
+        r = f["rank"]
+        now = time.monotonic()
+        self._h_arrive[r] = now
+        self._h_wait[r] = (now, f["timeout"])
+        if len(self._h_wait) == self.size:
+            arrive_t = dict(self._h_arrive)
+            for rr in self._h_wait:
+                sends.append(self._ok(rr, healthy=True,
+                                      arrived=sorted(arrive_t),
+                                      arrive_t=arrive_t))
+            self._h_wait.clear()
+            self._h_arrive.clear()
+
+    def _fail_health_round(self, sends) -> None:
+        """Report the current probe round failed to every waiter, with
+        the arrival snapshot (resettable: the round then clears)."""
+        arrive_t = dict(self._h_arrive)
+        arrived = sorted(arrive_t)
+        for rr in self._h_wait:
+            sends.append(self._ok(rr, healthy=False, arrived=arrived,
+                                  arrive_t=arrive_t))
+        self._h_wait.clear()
+        self._h_arrive.clear()
+
+    # ----------------------------------------------------- failure paths
+
+    def rank_died(self, rank: int, exc: BaseException) -> None:
+        """The reaper path: a worker SIGKILLed itself (dying frame), was
+        killed for real (EOF), or was preempted — attribute and wake
+        every parked peer, exactly like ``World.mark_dead`` + the
+        barrier aborts on threads."""
+        sends: List[Tuple[int, dict]] = []
+        with self._lock:
+            self._dead[rank] = exc
+            self._failed = True
+            if self.first_error is None:
+                self.first_error = exc
+            err = self._rank_failed_error("aborted")
+            for r in list(self._x_wait):
+                if r != rank:
+                    sends.append(self._err(r, err))
+            self._x_wait.clear()
+            self._x_sigs.clear()
+            self._x_pay.clear()
+            for key, parked in list(self._recv_wait.items()):
+                src = key[0]
+                for p in parked:
+                    if p.rank == rank:
+                        continue
+                    if src == rank:
+                        sends.append(self._err(
+                            p.rank, self._recv_dead_src_error(*key)))
+                    else:
+                        sends.append(self._err(
+                            p.rank, self._already_failed_error()))
+            self._recv_wait.clear()
+            self._h_wait.pop(rank, None)
+            self._h_arrive.pop(rank, None)
+            if self._h_wait:
+                self._fail_health_round(sends)
+        self._flush(sends)
+
+    def world_failed(self, exc: BaseException) -> None:
+        """A rank's body raised (its ``done`` frame carried the error):
+        wake parked peers — ``World.fail`` on threads."""
+        from ..runtime import CommError
+        sends: List[Tuple[int, dict]] = []
+        with self._lock:
+            if self.first_error is None:
+                self.first_error = exc
+            if self._failed:
+                return
+            self._failed = True
+            err = CommError(
+                "collective aborted because another rank failed")
+            for r in list(self._x_wait):
+                sends.append(self._err(r, err))
+            self._x_wait.clear()
+            self._x_sigs.clear()
+            self._x_pay.clear()
+            for parked in self._recv_wait.values():
+                for p in parked:
+                    sends.append(self._err(
+                        p.rank, self._already_failed_error()))
+            self._recv_wait.clear()
+            if self._h_wait:
+                self._fail_health_round(sends)
+        self._flush(sends)
+
+    # ------------------------------------------------------------ janitor
+
+    def tick(self) -> None:
+        """Patience enforcement — the janitor thread's beat.  Expired
+        exchange rounds tear with arrived/missing attribution; expired
+        receive windows first try a dropped-payload redelivery (the
+        NACK-triggered retransmission), then extend with capped
+        exponential backoff, then raise the timed-out-receive error."""
+        now = time.monotonic()
+        sends: List[Tuple[int, dict]] = []
+        with self._lock:
+            self._tick_exchange(now, sends)
+            self._tick_recv(now, sends)
+            self._tick_health(now, sends)
+        self._flush(sends)
+
+    def _tick_exchange(self, now, sends) -> None:
+        if not self._x_wait:
+            return
+        for r, w in self._x_wait.items():
+            if now > w.arrival + w.patience:
+                err = self._deadlock_error(self._x_wait)
+                self._x_broken = err
+                for rr in self._x_wait:
+                    sends.append(self._err(rr, err))
+                self._x_wait.clear()
+                self._x_sigs.clear()
+                self._x_pay.clear()
+                return
+
+    def _tick_recv(self, now, sends) -> None:
+        for key, parked in list(self._recv_wait.items()):
+            keep = []
+            for p in parked:
+                if now <= p.deadline:
+                    keep.append(p)
+                    continue
+                if p.attempt < p.retries:
+                    p.attempt += 1
+                    stash = self._dropped.get(key)
+                    if stash:
+                        payload = stash.pop(0)
+                        sends.append(self._ok(p.rank, payload=payload,
+                                              retries_used=1))
+                        continue
+                    from ..runtime import _backoff_pause
+                    p.deadline = now + _backoff_pause(
+                        p.attempt, p.backoff, p.timeout)
+                    keep.append(p)
+                else:
+                    sends.append(self._err(
+                        p.rank, self._recv_timeout_error(key)))
+            if keep:
+                self._recv_wait[key] = keep
+            else:
+                self._recv_wait.pop(key, None)
+
+    def _tick_health(self, now, sends) -> None:
+        for r, (arrival, timeout) in self._h_wait.items():
+            if now > arrival + timeout:
+                self._fail_health_round(sends)
+                return
+
+
+class _RunState:
+    """Per-run collection arrays the reader threads fill in."""
+
+    def __init__(self, n: int):
+        self.results: List[Any] = [None] * n
+        self.errors: List[Optional[BaseException]] = [None] * n
+        self.epilogues: List[Optional[dict]] = [None] * n
+        self.finished = [False] * n
+        self.died = [False] * n
+
+
+class ProcessTransport(Transport):
+    """Mode B over real worker processes (see module docstring)."""
+
+    name = "process"
+
+    def __init__(self):
+        # One world at a time per parent: the switchboard assumes rank
+        # identity == leased-worker index.  run_ranks callers already
+        # never nest worlds on one thread; this serializes across
+        # threads too.
+        self._run_lock = threading.Lock()
+
+    def run_ranks(self, fn: Callable, nranks: int,
+                  timeout: Optional[float] = None,
+                  return_results: bool = True) -> List[Any]:
+        from .. import config as _cfg
+        from ..runtime import _fn_nparams, _raise_primary
+        from . import note_external_preemption
+
+        # Same contract as the thread backend's World.__init__: the
+        # parent never builds a World here, so the guard must live at
+        # this entry or a size-0 run would silently return [].
+        if nranks < 1:
+            raise ValueError("World size must be >= 1")
+        if timeout is None:
+            timeout = float(os.environ.get(
+                "MPI4TORCH_TPU_WORLD_TIMEOUT", "60"))
+        fn_bytes = _ship.dumps(fn)
+        nparams = _fn_nparams(fn)
+        state = _cfg.snapshot_process_state()
+        plan = _cfg.fault_plan()
+        plan_frame = None
+        if plan is not None:
+            plan_frame = {"specs": plan.specs,
+                          "counts": dict(plan._counts)}
+        tracer = _cfg.comm_tracer()
+        trace_frame = {"ring": tracer.ring} if tracer is not None else None
+
+        with self._run_lock:
+            pool = shared_pool()
+            workers = pool.lease(nranks)
+            sb = _Switchboard(nranks, timeout, workers,
+                              on_preempt=note_external_preemption)
+            st = _RunState(nranks)
+            try:
+                for rank, w in enumerate(workers):
+                    w.send({"kind": "run", "rank": rank, "size": nranks,
+                            "timeout": timeout, "fn": fn_bytes,
+                            "nparams": nparams, "config": state,
+                            "plan": plan_frame, "trace": trace_frame})
+                stop = threading.Event()
+                janitor = threading.Thread(
+                    target=self._janitor, args=(sb, stop), daemon=True)
+                janitor.start()
+                readers = [threading.Thread(
+                    target=self._reader, args=(r, w, sb, st), daemon=True)
+                    for r, w in enumerate(workers)]
+                for t in readers:
+                    t.start()
+                for t in readers:
+                    t.join()
+                stop.set()
+                janitor.join()
+            finally:
+                self._merge_epilogues(nranks, plan, tracer, sb, st)
+                pool.release(workers)
+
+        _raise_primary(st.errors, sb.first_error)
+        return st.results if return_results else []
+
+    # ------------------------------------------------------------ threads
+
+    @staticmethod
+    def _janitor(sb: _Switchboard, stop: threading.Event) -> None:
+        while not stop.wait(_TICK_S):
+            sb.tick()
+
+    @staticmethod
+    def _reader(rank: int, w: Worker, sb: _Switchboard,
+                st: _RunState) -> None:
+        from ..runtime import RankFailedError
+        while True:
+            try:
+                f = recv_frame(w.sock)
+            except WireError:
+                f = None
+            if f is None:
+                # EOF.  A clean run already ended with a `done` frame;
+                # anything else is a real death (SIGKILL lands here,
+                # with or without a `dying` frame having made it out).
+                w.mark_dead()
+                if not st.finished[rank]:
+                    err = RankFailedError(
+                        f"rank {rank} died: transport worker "
+                        f"(pid {w.pid}) exited without a final frame",
+                        ranks=(rank,))
+                    st.errors[rank] = err
+                    st.finished[rank] = True
+                    st.died[rank] = True
+                    sb.rank_died(rank, err)
+                return
+            kind = f.get("kind")
+            if kind == "op":
+                sb.handle_op(f)
+            elif kind == "dying":
+                # The fault-injected death: evidence first, SIGKILL
+                # second.  The error is the child's own attributed
+                # raise; the epilogue feeds the ledger/tracer merges.
+                st.epilogues[rank] = f.get("epilogue")
+                err = f["error"]
+                st.errors[rank] = err
+                st.finished[rank] = True
+                st.died[rank] = True
+                sb.rank_died(rank, err)
+                # fall through to the EOF that follows the SIGKILL
+            elif kind == "done":
+                st.epilogues[rank] = f.get("epilogue")
+                if f["ok"]:
+                    st.results[rank] = f["result"]
+                else:
+                    st.errors[rank] = f["error"]
+                    sb.world_failed(f["error"])
+                st.finished[rank] = True
+                return
+
+    # -------------------------------------------------------------- merge
+
+    def _merge_epilogues(self, nranks: int, plan, tracer,
+                         sb: _Switchboard, st: _RunState) -> None:
+        from . import note_external_preemption
+        if plan is not None:
+            for r in range(nranks):
+                ep = st.epilogues[r]
+                if ep and ep.get("plan"):
+                    plan.absorb_remote(r, ep["plan"])
+        for r in range(nranks):
+            ep = st.epilogues[r]
+            if ep and ep.get("preempt") is not None:
+                note_external_preemption(r, ep["preempt"])
+        if tracer is not None:
+            tracer.absorb(sb, [
+                (st.epilogues[r] or {}).get("trace")
+                for r in range(nranks)])
+            for r in range(nranks):
+                # The reaper's flight-recorder duty (thread backend:
+                # run_ranks' reaper).  Ranks that raised and reported
+                # attributed themselves in their shipped postmortems; a
+                # rank that DIED gets attributed here — unless its dying
+                # frame already shipped the evidence.
+                shipped = ((st.epilogues[r] or {}).get("trace")
+                           or {}).get("postmortems")
+                if st.died[r] and st.errors[r] is not None \
+                        and not shipped:
+                    tracer.note_rank_failure(sb, r, st.errors[r])

@@ -1,0 +1,29 @@
+"""The thread transport: today's semantics, bit-for-bit.
+
+This is not a reimplementation — it IS the historical runtime.  The
+backend delegates straight back to ``runtime.run_ranks`` with
+``backend="thread"`` pinned (which takes the inline thread path), so
+the tier-1 default's behavior is the same code object it has always
+been, and the transport registry's "thread" entry can never drift from
+what ``run_ranks`` does by default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from .base import Transport
+
+__all__ = ["ThreadTransport"]
+
+
+class ThreadTransport(Transport):
+    name = "thread"
+
+    def run_ranks(self, fn: Callable, nranks: int,
+                  timeout: Optional[float] = None,
+                  return_results: bool = True) -> List[Any]:
+        from ..runtime import run_ranks
+
+        return run_ranks(fn, nranks, timeout=timeout,
+                         return_results=return_results, backend="thread")

@@ -1,0 +1,81 @@
+"""Pickle-framed socket wire of the process transport.
+
+One frame = an 8-byte big-endian length prefix + a pickle (highest
+protocol) of a plain dict with a ``"kind"`` key.  The framing is the
+whole protocol: no negotiation, no versioning handshake — parent and
+workers are always the same interpreter running the same checkout (the
+pool spawns them with ``sys.executable``), exactly like the reference's
+``mpirun`` launching N copies of one script.
+
+jax arrays pickle bit-exactly (device_get + dtype-preserving numpy
+round-trip), which is what makes the process transport's parity matrix
+*bitwise* rather than approximate: the bytes a payload carries across
+this wire are the bytes the thread backend's shared-memory handoff
+preserves by identity.
+
+Writes are serialized per socket by the caller-provided lock (the
+parent's switchboard replies from reader, completer, and janitor
+threads); reads have a single owner per socket (the child's main loop,
+or the parent's per-worker reader thread), so no read lock exists.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Optional
+
+# 8-byte length prefix: frames carry whole rendezvous payloads (a fused
+# bucket can be tens of MiB); 4 bytes would cap a frame at 4 GiB anyway
+# but the wider prefix keeps the framing future-proof for multi-host.
+_LEN = struct.Struct(">Q")
+
+# Hard ceiling on one frame — a corrupt length prefix must not turn
+# into a multi-terabyte allocation attempt.
+MAX_FRAME_BYTES = 1 << 34
+
+
+class WireError(ConnectionError):
+    """The peer vanished mid-frame or sent an unframeable length."""
+
+
+def send_frame(sock, obj: Any, lock=None) -> None:
+    """Pickle ``obj`` and write one frame (atomic under ``lock``)."""
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    frame = _LEN.pack(len(data)) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def recv_frame(sock) -> Optional[dict]:
+    """Read one frame; ``None`` on clean EOF (peer closed between
+    frames).  EOF *mid*-frame raises :class:`WireError` — a death
+    during a write is a failure, not a shutdown."""
+    head = _recv_exact(sock, _LEN.size, eof_ok=True)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME_BYTES:
+        raise WireError(f"frame length {n} exceeds {MAX_FRAME_BYTES}")
+    body = _recv_exact(sock, n, eof_ok=False)
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n: int, eof_ok: bool):
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(min(n - len(buf), 1 << 20))
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            if eof_ok and not buf:
+                return None
+            raise WireError(f"connection lost mid-frame: {e}") from e
+        if not chunk:
+            if eof_ok and not buf:
+                return None
+            raise WireError("peer closed the connection mid-frame")
+        buf += chunk
+    return bytes(buf)

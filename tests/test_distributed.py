@@ -189,19 +189,27 @@ def _free_port() -> int:
     return port
 
 
-# The two real-OS-process integration tests below exercise the
-# coordination-service rendezvous end to end, but the compiled collective
+# The two real-OS-process Mode A integration tests below exercise the
+# coordination-service rendezvous end to end, but the COMPILED collective
 # itself cannot run on this harness: the CPU PJRT backend has no
 # multi-process collective implementation (workers die with
 # "INVALID_ARGUMENT: Multiprocess computations aren't implemented on the
-# CPU backend").  Pre-existing platform gap, documented in CHANGES.md
-# since PR 1; xfail (non-strict) keeps tier-1 green so REAL regressions
-# are visible, while a TPU/multi-host run — where the collective does
-# exist — reports xpass instead of being skipped.
+# CPU backend").  The gap is Mode A-ONLY: since the transport runtime
+# landed (mpi4torch_tpu.transport), the SAME multi-process shapes run
+# and PASS over the Mode B process backend — see the
+# ``*_process_backend`` companions right below each xfail, which launch
+# real worker processes through ``run_ranks(..., backend="process")``
+# and assert bitwise parity against the thread oracle.  The xfail
+# (non-strict) stays only on the compiled-collective variants, where a
+# TPU/multi-host run — the one place the Mode A collective exists —
+# reports xpass instead of being skipped.
 _MULTIPROC_CPU_GAP = pytest.mark.xfail(
-    reason="multi-process collectives are unimplemented on the CPU PJRT "
-           "backend ('Multiprocess computations aren't implemented on the "
-           "CPU backend'); needs a real TPU/multi-host runtime",
+    reason="Mode A-only gap: multi-process COMPILED collectives are "
+           "unimplemented on the CPU PJRT backend ('Multiprocess "
+           "computations aren't implemented on the CPU backend'); the "
+           "Mode B process-transport companion tests cover the "
+           "multi-process semantics on this harness, this variant needs "
+           "a real TPU/multi-host runtime",
     strict=False)
 
 
@@ -238,6 +246,36 @@ class TestTwoProcessIntegration:
             assert p.returncode == 0, f"worker {pid} failed:\n{out}"
             assert f"WORKER-{pid}-OK" in out
 
+    def test_two_process_allreduce_fwd_bwd_process_backend(self):
+        # The flipped half of the standing xfail above: the same
+        # 2-real-process allreduce forward+backward, but through the
+        # Mode B process transport — each rank is a REAL worker process
+        # (distinct PID from the launcher), and the results must be
+        # bitwise what the thread backend computes.
+        def body(rank):
+            x = (rank + 1.0) * jnp.ones((4,), jnp.float32)
+
+            def loss(x):
+                y = mpi.COMM_WORLD.Allreduce(x, mpi.MPI_SUM)
+                return jnp.vdot(y, jnp.ones((4,))), y
+
+            (_, y), grad = jax.value_and_grad(loss, has_aux=True)(x)
+            return np.asarray(y), np.asarray(grad), os.getpid()
+
+        got = mpi.run_ranks(body, 2, backend="process")
+        oracle = mpi.run_ranks(body, 2, backend="thread")
+        for rank in range(2):
+            y, grad, pid = got[rank]
+            np.testing.assert_array_equal(y, oracle[rank][0])
+            np.testing.assert_array_equal(grad, oracle[rank][1])
+            # y = sum_r (r+1) * ones = 3 * ones; the adjoint of an
+            # allreduce-sum is another allreduce-sum, so the ones
+            # cotangent comes back summed over both ranks: grad = 2.
+            np.testing.assert_array_equal(y, 3.0 * np.ones(4, np.float32))
+            np.testing.assert_array_equal(grad, 2.0 * np.ones(4, np.float32))
+            assert pid != os.getpid(), "rank body ran in the launcher"
+        assert got[0][2] != got[1][2], "both ranks shared one process"
+
 
 class TestHybridMeshMultiGranule:
     @_MULTIPROC_CPU_GAP
@@ -267,6 +305,37 @@ class TestHybridMeshMultiGranule:
         for pid, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {pid} failed:\n{out}"
             assert f"HYBRID-WORKER-{pid}-OK" in out
+
+    def test_deterministic_fold_parity_process_backend(self):
+        # The flipped half of the hybrid xfail: the deterministic
+        # ordered-fold guarantee across REAL process boundaries.  The
+        # thread-backend eager run is the oracle; the same body on the
+        # process backend — workers inheriting the launcher's
+        # ordered-fold knobs via the config-shipping contract — must
+        # reproduce it bit for bit on both ordered-fold lowerings.
+        data = np.stack([np.sin(np.arange(129, dtype=np.float32) * (r + 1))
+                         for r in range(3)]).astype(np.float32)
+
+        def det_body(rank):
+            with mpi.config.deterministic_mode(True):
+                return np.asarray(mpi.COMM_WORLD.Allreduce(
+                    jnp.asarray(data[rank]), mpi.MPI_SUM))
+
+        prev_gather = mpi.config.ordered_fold_gather_max_bytes()
+        prev_chunk = mpi.config.ordered_ring_chunk_bytes()
+        try:
+            for fold in ("gather", "ring"):
+                if fold == "ring":
+                    mpi.config.set_ordered_fold_gather_max_bytes(0)
+                    mpi.config.set_ordered_ring_chunk_bytes(256)
+                oracle = mpi.run_ranks(det_body, 3, backend="thread")
+                got = mpi.run_ranks(det_body, 3, backend="process")
+                for rk in range(3):
+                    np.testing.assert_array_equal(
+                        got[rk], oracle[rk], err_msg=f"{fold} rank {rk}")
+        finally:
+            mpi.config.set_ordered_fold_gather_max_bytes(prev_gather)
+            mpi.config.set_ordered_ring_chunk_bytes(prev_chunk)
 
 
 _MPI4PY_WORKER = textwrap.dedent("""
