@@ -114,8 +114,12 @@ reshard-smoke:
 # step (overlap < 1.0, blocking == 1.0), the latency-tier selection
 # assertion on the real decode message sizes (selector pick + the
 # resolved Allreduce_start.<algo> spans in the lowered program), and a
-# rank_death-mid-decode attribution cell.  Exits non-zero on any
-# divergence.
+# rank_death-mid-decode attribution cell.  The paged-KV cells
+# (ISSUE 17): engine-vs-oracle bitwise under block churn on a tight
+# page pool, the prefix-sharing prefilled-exactly-once census, the
+# mpi4torch_serve_* counter-mirror assertion, and the no-retrace
+# lowered-text identity of the paged decode step across block-table
+# states.  Exits non-zero on any divergence.
 serve-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \

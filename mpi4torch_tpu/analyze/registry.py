@@ -33,6 +33,7 @@ __all__ = [
     "degrade_problems",
     "reshard_step_problems",
     "serve_policy_problems",
+    "serve_paging_problems",
     "tune_problems",
     "overlap_split_phase_problems",
     "csched_problems",
@@ -226,6 +227,34 @@ def serve_policy_problems(parity_policies: Iterable) -> List[str]:
         "— every scheduling policy needs oracle-parity coverage")
 
 
+def serve_paging_problems() -> List[str]:
+    """Paged-serving registry-sync guards (ISSUE 17): every scheduling
+    policy must also hold engine-vs-oracle parity UNDER BLOCK CHURN
+    (the paged matrix literal published by the serve-smoke lane), and
+    every ServeStats counter must be mirrored into the
+    ``mpi4torch_serve_*`` obs metrics surface (the mirror literal the
+    smoke lane asserts against ``prometheus_text()``) — a new counter
+    cannot ship unmirrored, a new policy cannot ship without paged
+    parity coverage."""
+    from ..serve import POLICIES
+    from ..serve.__main__ import (MIRRORED_SERVE_COUNTERS,
+                                  PAGED_PARITY_POLICIES)
+    from ..utils.profiling import ServeStats
+
+    problems = set_drift(
+        POLICIES, PAGED_PARITY_POLICIES,
+        "policy registry {registered} != paged-parity covered set "
+        "{covered} — every scheduling policy needs oracle-parity "
+        "coverage under block churn too")
+    problems += set_drift(
+        ServeStats._COUNTERS, MIRRORED_SERVE_COUNTERS,
+        "ServeStats counters {registered} != obs-mirrored set "
+        "{covered} — every serve counter must surface as an "
+        "mpi4torch_serve_* metric (serve/__main__.py smoke asserts "
+        "the exposition)")
+    return problems
+
+
 # ------------------------------------------------------------------- tune
 
 def tune_problems(algos: Iterable, census_covered: Iterable,
@@ -370,4 +399,5 @@ def standing_problems() -> List[str]:
     from ..serve.__main__ import PARITY_POLICIES
     problems += [f"serve: {p}"
                  for p in serve_policy_problems(PARITY_POLICIES)]
+    problems += [f"serve: {p}" for p in serve_paging_problems()]
     return problems

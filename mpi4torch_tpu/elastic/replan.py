@@ -188,6 +188,16 @@ class ServeTicket:
     # by resize downtime is surfaced as ``deadline_expired`` at
     # re-admission (see :func:`readmit`), never silently dropped.
     deadline_s: Optional[float] = None
+    # Block-table state at drain time (ISSUE 17; None on dense
+    # engines): ``{"block_ids": [...], "n_tokens": int}`` — the pages
+    # that held the request's written rows.  A paged source engine
+    # registers those pages in its content-addressed prefix index
+    # before releasing them, so re-admitting into the SAME pool
+    # prefix-matches them back (blocks intact: the re-prefill is one
+    # COW copy + a one-token suffix, and the stitched stream stays
+    # bitwise the generate() oracle).  Carried explicitly so an
+    # elastic driver can census/assert page reuse across a resize.
+    pages: Optional[dict] = None
 
     @property
     def remaining(self) -> int:
@@ -224,7 +234,8 @@ def drain_tickets(engine, *, snapshot: bool = False
                            emitted=list(r["emitted"]),
                            max_new=r["max_new"], key=r["key"],
                            deadline_s=(None if r.get("deadline") is None
-                                       else r["deadline"] - now))
+                                       else r["deadline"] - now),
+                           pages=r.get("pages"))
                for r in reqs]
     return tickets, engine.results()
 

@@ -13,13 +13,16 @@ ring, with a jnp fallback for ineligible shapes/platforms.
 """
 
 from .flash import flash_attention, flash_block_attention, merge_partials
-from .ragged import (ragged_allgather, ragged_alltoall, ragged_gather,
-                     ragged_scatter, segment_mask)
+from .ragged import (block_gather, block_scatter, ragged_allgather,
+                     ragged_alltoall, ragged_gather, ragged_scatter,
+                     segment_mask)
 
 __all__ = [
     "flash_attention",
     "flash_block_attention",
     "merge_partials",
+    "block_gather",
+    "block_scatter",
     "ragged_allgather",
     "ragged_alltoall",
     "ragged_gather",

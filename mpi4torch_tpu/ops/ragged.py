@@ -95,6 +95,97 @@ def _validated_scalar_count(opname: str, x, count):
     return capacity, jnp.clip(count, 0, capacity)
 
 
+def block_gather(pool, table):
+    """Static-shape gather of a paged KV pool through a block table.
+
+    ``pool``: ``(num_blocks, block_size, *feat)`` — the fixed-size page
+    pool (the serving KV cache's paged form; one shared block-id space).
+    ``table``: ``(rows, n_blk)`` int block ids, ``-1`` (any negative)
+    marking an unmapped entry.  Returns ``(rows, n_blk * block_size,
+    *feat)``: each row's pages concatenated in table order, unmapped
+    entries yielding all-zero pages (the inert padded tail — downstream
+    causal/validity masks must make them irrelevant, and the serving
+    decode's per-row causal frontier does exactly that).
+
+    The table is DATA, not structure: one compiled program serves every
+    table state, which is the no-retrace contract that lets the pool
+    churn freely under one decode-step executable.  Values move by
+    gather only — never arithmetic — so mapped pages come back
+    bit-identical in ``pool``'s dtype."""
+    pool = jnp.asarray(pool)
+    if pool.ndim < 2:
+        raise ValueError(
+            f"block_gather expects pool of shape (num_blocks, "
+            f"block_size, *feat); got {pool.shape}")
+    t = jnp.asarray(table, jnp.int32)
+    if t.ndim != 2:
+        raise ValueError(
+            f"block_gather expects a (rows, n_blk) table; got shape "
+            f"{t.shape}")
+    nb, bs = pool.shape[0], pool.shape[1]
+    g = jnp.take(pool, jnp.clip(t, 0, nb - 1).reshape(-1), axis=0)
+    g = g.reshape(t.shape + pool.shape[1:])        # (rows, n_blk, bs, *f)
+    valid = (t >= 0).reshape(t.shape + (1,) * (g.ndim - 2))
+    g = jnp.where(valid, g, jnp.zeros((), pool.dtype))
+    return g.reshape((t.shape[0], t.shape[1] * bs) + pool.shape[2:])
+
+
+def block_scatter(pool, block_ids, offsets, values, active=None):
+    """One-hot write of one row per writer into a paged pool — the
+    block-granular counterpart of the :func:`position_onehot` slot-table
+    cache write.
+
+    ``pool``: ``(num_blocks, block_size, *feat)``.  ``block_ids`` /
+    ``offsets``: ``(writers,)`` int — writer ``w`` targets
+    ``pool[block_ids[w], offsets[w]]`` with ``values[w]`` (``(writers,
+    *feat)``).  ``active`` (``(writers,)`` bool/int, optional) masks
+    writers out entirely; out-of-range ids/offsets (including the
+    engine's ``-1`` free-slot convention) also write nothing, so an
+    inactive row needs no special-cased table state.
+
+    Writers must target DISTINCT (block, offset) cells — the serving
+    invariant that live slots own disjoint write positions (shared
+    prefix blocks are read-only; writes land in private pages, the
+    copy-on-write rule).  Under that invariant the write is exact: the
+    winning value is routed by integer one-hot masks and a gather
+    (``where`` selects, never sums), so written cells carry ``values``'
+    bits cast to ``pool``'s dtype and untouched cells keep theirs.
+    Static shapes throughout — same compiled program for any table
+    churn.  (This jnp formulation materializes a ``(num_blocks,
+    block_size, *feat)`` routing intermediate; a TPU deployment would
+    drop in a real scatter kernel behind the same contract.)"""
+    pool = jnp.asarray(pool)
+    values = jnp.asarray(values)
+    if pool.ndim < 2:
+        raise ValueError(
+            f"block_scatter expects pool of shape (num_blocks, "
+            f"block_size, *feat); got {pool.shape}")
+    if values.shape[1:] != pool.shape[2:]:
+        raise ValueError(
+            f"block_scatter values feature shape {values.shape[1:]} "
+            f"must match pool feature shape {pool.shape[2:]}")
+    nb, bs = pool.shape[0], pool.shape[1]
+    b = jnp.asarray(block_ids, jnp.int32)
+    o = jnp.asarray(offsets, jnp.int32)
+    live = (b >= 0) & (b < nb) & (o >= 0) & (o < bs)
+    if active is not None:
+        live = live & (jnp.asarray(active).astype(bool))
+    bmask = (jnp.arange(nb, dtype=jnp.int32)[None, :] == b[:, None]) \
+        & live[:, None]                                  # (writers, nb)
+    omask = position_onehot(o, bs) != 0                  # (writers, bs)
+    cell = bmask[:, :, None] & omask[:, None, :]         # (writers, nb, bs)
+    hit = cell.any(axis=0)                               # (nb, bs)
+    # Integer one-hot routing: the writer index owning each hit cell
+    # (exact — at most one contributor under the disjoint-cells
+    # invariant; 0 elsewhere, where `hit` suppresses the write).
+    writer = jnp.einsum("wnb,w->nb", cell.astype(jnp.int32),
+                        jnp.arange(b.shape[0], dtype=jnp.int32))
+    src = jnp.take(values, writer.reshape(-1), axis=0).reshape(
+        (nb, bs) + values.shape[1:])
+    mask = hit.reshape((nb, bs) + (1,) * (pool.ndim - 2))
+    return jnp.where(mask, src.astype(pool.dtype), pool)
+
+
 def ragged_alltoall(comm, x, send_counts) -> Tuple:
     """All-to-all with per-destination-varying segment sizes (the
     MPI_Alltoallv analogue; reference's same-axis Alltoall with varying

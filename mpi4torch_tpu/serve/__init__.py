@@ -51,8 +51,10 @@ from ..utils.profiling import (ServeStats, reset_serve_stats,
 from .engine import (Engine, POLICIES, SHED_POLICIES, STATUS_EXPIRED,
                      STATUS_OK, STATUS_SHED, QueueFullError, Request,
                      ServeConfig)
-from .kv import (admit_zero3, decode_step_tp, init_kv_cache_tp,
+from .kv import (admit_zero3, decode_step_paged, decode_step_tp,
+                 init_kv_cache_tp, init_kv_pool_tp, prefill_chunk_tp,
                  prefill_tp, shard_params_tp, validate_tp)
+from .paging import BlockManager
 
 __all__ = [
     "Engine",
@@ -65,9 +67,13 @@ __all__ = [
     "STATUS_SHED",
     "QueueFullError",
     "decode_step_tp",
+    "decode_step_paged",
     "prefill_tp",
+    "prefill_chunk_tp",
     "shard_params_tp",
     "init_kv_cache_tp",
+    "init_kv_pool_tp",
+    "BlockManager",
     "admit_zero3",
     "validate_tp",
     "latency_report",

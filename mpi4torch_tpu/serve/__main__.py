@@ -19,7 +19,20 @@ attached (the Makefile's ``serve-smoke`` target runs it on the
    resolved ``Allreduce_start.<algo>`` span with no bandwidth-tier
    schedule anywhere in the decode step;
 4. **fault composition** — a ``rank_death`` injected mid-decode on the
-   eager world raises an attributed ``RankFailedError``.
+   eager world raises an attributed ``RankFailedError``;
+5. **paged bitwise under block churn** (ISSUE 17) — the paged engine
+   (tight pool: fewer pages than dense-equivalent, so pages churn and
+   cached pages evict) bitwise vs the oracle under every policy;
+6. **prefix sharing lowers the shared prefill exactly once** — two
+   requests sharing a system prompt: the ``prefill_tokens`` census
+   counts the shared prefix ONCE, and the sharers' table rows hold the
+   SAME page ids for the shared span;
+7. **counter mirror** — every ``ServeStats`` counter (pinned by
+   ``MIRRORED_SERVE_COUNTERS`` + the registry guard) appears in
+   ``obs.prometheus_text()`` as an ``mpi4torch_serve_*`` metric;
+8. **no-retrace census** — the paged decode step lowers to IDENTICAL
+   program text across two different block-table states (the table is
+   an argument, not structure), with a stable block-gather op count.
 
 Exits non-zero on any divergence, so the lane is a real check, not a
 demo.
@@ -34,6 +47,24 @@ import sys
 # coverage — the registry-sync guard discipline of test_tune/
 # test_overlap, applied to admission scheduling.
 PARITY_POLICIES = ("fcfs", "shortest_first")
+
+# The policies covered by the PAGED engine-vs-oracle matrix (cell 5
+# below and tests/test_serve.py::TestPagedOracleParity): must equal
+# serve.POLICIES — analyze.registry.serve_paging_problems drifts
+# otherwise.
+PAGED_PARITY_POLICIES = ("fcfs", "shortest_first")
+
+# Every ServeStats counter mirrored into the obs metrics surface as
+# mpi4torch_serve_<name> (cell 7 asserts the exposition literally).
+# Must equal utils.profiling.ServeStats._COUNTERS — the registry guard
+# makes adding a counter without mirroring it a loud failure.
+MIRRORED_SERVE_COUNTERS = (
+    "steps", "admitted", "evicted", "finished", "rejected",
+    "decode_tokens", "occupancy_ticks", "slot_ticks",
+    "deadline_expired", "shed",
+    "prefix_hits", "prefix_misses", "prefill_tokens", "cow_copies",
+    "preempted", "blocks_in_use", "blocks_free", "blocks_cached",
+)
 
 
 def _smoke() -> int:
@@ -195,6 +226,117 @@ def _smoke() -> int:
                 return 1
         print("faults: rank_death mid-decode -> RankFailedError(ranks="
               "{1}) on every survivor")
+
+    # 5. Paged engine bitwise under BLOCK CHURN (ISSUE 17): a pool
+    # smaller than dense-equivalent, so pages churn (and cached pages
+    # evict) while 4 requests run through 2 slots — plus the paged
+    # registry-sync guard.
+    from mpi4torch_tpu.analyze.registry import serve_paging_problems
+
+    sync = serve_paging_problems()
+    if sync:
+        for p in sync:
+            print(f"FAIL: {p}")
+        return 1
+
+    for policy in sorted(serve.POLICIES):
+        serve.reset_stats()
+        eng = serve.Engine(
+            cfg, params,
+            serve.ServeConfig(slots=2, policy=policy, overlap=True,
+                              block_size=4, num_blocks=6),
+            spmd=True, nranks=size)
+        for p, n in zip(prompts, budgets):
+            eng.submit(p, max_new=n)
+        if not check(eng.run(),
+                     f"paged Mode A ({size},) policy={policy}"):
+            return 1
+    print(f"paged engine: bitwise == per-request generate() on "
+          f"({size},), both policies, 6-page pool churn")
+
+    # 6. Prefix sharing: the shared prefix prefills EXACTLY ONCE.
+    serve.reset_stats()
+    eng = serve.Engine(cfg, params,
+                       serve.ServeConfig(slots=2, block_size=4),
+                       spmd=True, nranks=size)
+    sys_prompt = np.arange(1, 9)                 # 8 tokens = 2 pages
+    pa = np.concatenate([sys_prompt, [20, 21]])
+    pb = np.concatenate([sys_prompt, [22]])
+    ra = eng.submit(pa, max_new=4)
+    rb = eng.submit(pb, max_new=4)
+    eng.step()                     # both admitted: tables are live NOW
+    sa = [s for r, s in eng.slot_log if r == ra][0]
+    sb = [s for r, s in eng.slot_log if r == rb][0]
+    shared_pages = [int(b) for b in eng._table[sb][:2]]
+    if [int(b) for b in eng._table[sa][:2]] != shared_pages \
+            or min(shared_pages) < 0:
+        print(f"FAIL: sharers do not reference the SAME prefix pages "
+              f"({list(eng._table[sa][:2])} vs {shared_pages})")
+        return 1
+    res = eng.run()
+    for rid, p in ((ra, pa), (rb, pb)):
+        if not np.array_equal(np.asarray(res[rid]), oracle(p, 4)):
+            print("FAIL: prefix-sharing engine diverges from oracle")
+            return 1
+    snap = eng.stats.snapshot()
+    want_prefill = len(pa) + (len(pb) - len(sys_prompt))
+    if snap["prefill_tokens"] != want_prefill:
+        print(f"FAIL: shared prefix not prefilled exactly once: "
+              f"{snap['prefill_tokens']} prefill tokens, expected "
+              f"{want_prefill} (= {len(pa)} + {len(pb)} - "
+              f"{len(sys_prompt)} shared)")
+        return 1
+    if snap["prefix_hits"] != 1:
+        print(f"FAIL: expected exactly one prefix hit, got "
+              f"{snap['prefix_hits']}")
+        return 1
+    print(f"prefix sharing: {len(sys_prompt)}-token system prompt "
+          f"prefilled once ({snap['prefill_tokens']} prefill tokens "
+          f"for 2 requests), pages {shared_pages} shared by both slots")
+
+    # 7. Counter mirror: every pinned ServeStats counter surfaces as an
+    # mpi4torch_serve_* metric in the Prometheus exposition.
+    from mpi4torch_tpu import obs
+
+    txt = obs.prometheus_text()
+    missing = [c for c in MIRRORED_SERVE_COUNTERS
+               if f"mpi4torch_serve_{c} " not in txt]
+    if missing:
+        print(f"FAIL: counters missing from prometheus_text(): "
+              f"{missing}")
+        return 1
+    print(f"obs mirror: all {len(MIRRORED_SERVE_COUNTERS)} serve "
+          "counters exposed as mpi4torch_serve_*")
+
+    # 8. No-retrace census: the paged decode step lowers IDENTICALLY
+    # across two different block-table states — the table is data.
+    eng = serve.Engine(cfg, params,
+                       serve.ServeConfig(slots=2, block_size=4,
+                                         overlap=True),
+                       spmd=True, nranks=size)
+    eng.submit(prompts[0], max_new=6)
+    eng.step()
+    txt1 = lowered_text(eng.lower_step(), debug_info=False)
+    eng.submit(prompts[1], max_new=4)   # second slot maps fresh pages
+    eng.step()
+    txt2 = lowered_text(eng.lower_step(), debug_info=False)
+    if txt1 != txt2:
+        print("FAIL: paged decode step retraces across table states")
+        return 1
+    n_gather = txt1.count('"stablehlo.gather"')
+    if n_gather < 2 * cfg.n_layers:
+        print(f"FAIL: paged decode step censuses only {n_gather} "
+              f"gather ops; expected >= {2 * cfg.n_layers} "
+              "(one block gather per K and V per layer)")
+        return 1
+    res = eng.run()
+    if not (np.array_equal(np.asarray(res[0]), oracle(prompts[0], 6))
+            and np.array_equal(np.asarray(res[1]),
+                               oracle(prompts[1], 4))):
+        print("FAIL: no-retrace engine diverges from oracle")
+        return 1
+    print(f"no-retrace: paged decode step text identical across table "
+          f"states ({n_gather} gather ops censused)")
 
     print("serve-smoke: OK")
     return 0

@@ -57,6 +57,7 @@ from ..models.transformer import TransformerConfig, select_token
 from ..runtime import CommError
 from ..utils import profiling as _prof
 from . import kv as _kv
+from . import paging as _paging
 
 __all__ = ["ServeConfig", "Request", "Engine", "POLICIES",
            "SHED_POLICIES", "QueueFullError",
@@ -147,7 +148,24 @@ class ServeConfig:
     (None = reject with :class:`QueueFullError`) turns that rejection
     into load shedding: a QUEUED request is evicted with the typed
     ``shed`` result status and the new submit is accepted —
-    :data:`SHED_POLICIES` picks the victim."""
+    :data:`SHED_POLICIES` picks the victim.
+
+    **Paging (ISSUE 17).**  ``block_size > 0`` switches the KV cache
+    from the dense ``(slots, max_seq)`` rows to a pool of fixed-size
+    TP-sharded pages addressed through a per-slot block table
+    (``block_size`` must divide ``cfg.max_seq``; checked at engine
+    construction).  ``num_blocks`` sizes the pool (None = ``slots *
+    max_seq / block_size``, dense-equivalent capacity — shrink it to
+    overcommit on real length distributions, which is the point).
+    ``prefix_cache`` (on by default) shares identical prompt prefixes
+    copy-on-write across requests, prefilled once; ``prefill_chunk``
+    (paged only) caps the prompt tokens prefilled per engine step —
+    longer prompts interleave chunk-by-chunk with ongoing decode steps
+    so one long prompt never stalls resident slots' emission (the TTFT
+    bound).  Both exactness-gate on ``cache_dtype`` matching the
+    parameter dtype (a down-cast cache would re-quantize shared prefix
+    rows the per-request oracle keeps at full precision); the gate
+    disables sharing/chunking, never bitwise parity."""
     slots: int = 4
     max_new: int = 16
     eos: Optional[int] = None
@@ -159,6 +177,10 @@ class ServeConfig:
     queue_limit: Optional[int] = None
     cache_dtype: Any = None
     shed_policy: Optional[str] = None
+    block_size: int = 0
+    num_blocks: Optional[int] = None
+    prefix_cache: bool = True
+    prefill_chunk: Optional[int] = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -182,6 +204,22 @@ class ServeConfig:
                 f"unknown shed policy {self.shed_policy!r}; registered: "
                 f"{sorted(SHED_POLICIES)} (or None to reject with "
                 "QueueFullError)")
+        if self.block_size < 0:
+            raise ValueError(
+                f"block_size must be >= 0 (0 = dense slot-table cache), "
+                f"got {self.block_size}")
+        if self.num_blocks is not None and self.num_blocks < 1:
+            raise ValueError(
+                f"num_blocks must be >= 1 or None, got {self.num_blocks}")
+        if self.prefill_chunk is not None:
+            if self.block_size == 0:
+                raise ValueError(
+                    "prefill_chunk requires paging (block_size > 0) — "
+                    "chunked prefill installs per-chunk rows into pages")
+            if self.prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1 or None, got "
+                    f"{self.prefill_chunk}")
 
 
 @dataclass(eq=False)
@@ -206,6 +244,20 @@ class Request:
             return True
         return (eos is not None and self.emitted
                 and self.emitted[-1] == eos)
+
+
+@dataclass(eq=False)
+class _PrefillJob:
+    """A chunked prefill in progress (paged engines): the request holds
+    its reserved slot (inactive — decode skips it) while its prompt
+    lands chunk by chunk, ONE chunk per engine step, interleaved with
+    the resident slots' decode — the TTFT bound: a long prompt never
+    stalls emission for sequences already decoding.  ``done`` counts
+    prompt rows whose K/V is installed (shared prefix included)."""
+    req: Request
+    slot: int
+    seq: np.ndarray
+    done: int = 0
 
 
 class Engine:
@@ -254,6 +306,14 @@ class Engine:
         _kv.validate_tp(cfg, self._size)
         self._dtype = (self.serve_cfg.cache_dtype
                        or params["embed"].dtype)
+        self._paged = self.serve_cfg.block_size > 0
+        # Exactness gate for prefix sharing and chunked prefill: both
+        # splice CACHE-dtype rows into prefill attention, which is only
+        # bit-identical to the one-shot oracle when the cache carries
+        # the compute dtype.  A down-cast cache keeps paging (storage)
+        # but prefills every prompt in full, like the dense path.
+        self._exact_kv = (jnp.dtype(self._dtype)
+                          == jnp.dtype(params["embed"].dtype))
 
         if self._spmd:
             from ..ops.spmd import run_spmd
@@ -271,20 +331,54 @@ class Engine:
             self._shards = run_spmd(
                 lambda: _kv.shard_params_tp(cfg, params, COMM_WORLD),
                 **kw)()
-            self._step_call = run_spmd(self._traced_step, **kw)
+            self._step_call = run_spmd(
+                self._traced_step_paged if self._paged
+                else self._traced_step, **kw)
             # One wrapper serves every prompt length: the jit under
             # run_spmd caches per input shape on its own.
             self._prefill_call = run_spmd(self._traced_prefill, **kw)
+            self._chunk_call = run_spmd(self._traced_prefill_chunk,
+                                        **kw) if self._paged else None
         else:
             # Eager: the rank is concrete here (rank thread or the
             # size-1 world) — shard once.
             self._shards = _kv.shard_params_tp(cfg, params, self._comm)
             self._step_call = None
             self._prefill_call = None
+            self._chunk_call = None
 
         slots = self.serve_cfg.slots
-        cache = _kv.init_kv_cache_tp(cfg, slots, self._size, self._dtype,
-                                     poison=True)
+        if self._paged:
+            bs = self.serve_cfg.block_size
+            if cfg.max_seq % bs != 0:
+                raise ValueError(
+                    f"block_size={bs} must divide max_seq={cfg.max_seq} "
+                    "(the paged gather reconstructs the dense attention "
+                    "extent — see serve.kv.init_kv_pool_tp)")
+            self._blocks_per_seq = cfg.max_seq // bs
+            nb = (self.serve_cfg.num_blocks
+                  if self.serve_cfg.num_blocks is not None
+                  else slots * self._blocks_per_seq)
+            cache = _kv.init_kv_pool_tp(cfg, nb, bs, self._size,
+                                        self._dtype)
+            self._mgr = _paging.BlockManager(
+                nb, bs,
+                prefix_cache=(self.serve_cfg.prefix_cache
+                              and self._exact_kv))
+            # Host-side block table, mirrored into the step as DATA.
+            self._table = np.full((slots, self._blocks_per_seq), -1,
+                                  np.int32)
+            self._prefill_jobs: deque = deque()
+            self._admit_seq = 0                  # preemption-victim order
+            self._slot_seq = [0] * slots
+            self._chunk = (self.serve_cfg.prefill_chunk
+                           if self._exact_kv else None)
+        else:
+            cache = _kv.init_kv_cache_tp(cfg, slots, self._size,
+                                         self._dtype, poison=True)
+            self._mgr = None
+            self._table = None
+            self._chunk = None
         if self._spmd:
             # Stacked per-rank state: leading (size,) axis — exactly the
             # rank-major layout run_spmd's outputs carry, so the state
@@ -296,6 +390,10 @@ class Engine:
         self._tokens = np.zeros((slots,), np.int32)
         self._pos = np.zeros((slots,), np.int32)
         self._slot_req: List[Optional[Request]] = [None] * slots
+        # True while a slot's chunked prefill is in flight: the slot is
+        # reserved (occupancy counts it) but NOT in the decode active
+        # set until its first token lands.
+        self._prefilling: List[bool] = [False] * slots
         self._queue: deque = deque()
         self._results: Dict[Any, np.ndarray] = {}
         self._statuses: Dict[Any, str] = {}
@@ -333,6 +431,26 @@ class Engine:
         return _kv.prefill_tp(self.cfg, self._rank_slice(shards), cache,
                               prompt, comm)
 
+    def _traced_step_paged(self, shards, pool, table, tokens, pos,
+                           active):
+        """Mode A paged decode step: shard/pool state stacked per rank,
+        the block table riding replicated as DATA — one compiled
+        program for every table state (no retrace as pages churn)."""
+        return _kv.decode_step_paged(
+            self.cfg, self._rank_slice(shards),
+            self._rank_slice(pool), table, tokens, pos, COMM_WORLD,
+            overlap=self.serve_cfg.overlap,
+            algorithm=self.serve_cfg.algorithm, active=active)
+
+    def _traced_prefill_chunk(self, shards, past, chunk):
+        """Mode A chunk/suffix prefill: ``past`` is the stacked
+        exact-length prefix K/V gathered host-side from the pool at
+        concrete page ids (compiles per (prefix, chunk) length pair,
+        like prefill itself compiles per prompt length)."""
+        return _kv.prefill_chunk_tp(
+            self.cfg, self._rank_slice(shards), self._rank_slice(past),
+            chunk, COMM_WORLD)
+
     # -------------------------------------------------------------- public
 
     def submit(self, prompt, *, rid=None, max_new: Optional[int] = None,
@@ -359,6 +477,19 @@ class Engine:
             raise ValueError(
                 f"prompt {prompt.size} + n_new {budget} exceeds max_seq "
                 f"{self.cfg.max_seq}")
+        if self._paged:
+            # Worst-case page footprint (positions 0 .. p+budget-2; the
+            # final token is selected, never written): a request that
+            # could not run even ALONE on the pool would preempt-loop
+            # forever, so it is rejected here like the max_seq check.
+            bs = self.serve_cfg.block_size
+            need = -(-(int(prompt.size) + budget - 1) // bs)
+            if need > self._mgr.num_blocks:
+                raise ValueError(
+                    f"prompt {prompt.size} + n_new {budget} needs "
+                    f"{need} pages of {bs} tokens; the pool has only "
+                    f"{self._mgr.num_blocks} — raise num_blocks or "
+                    "shrink the request")
         if self.serve_cfg.temperature > 0 and key is None:
             raise ValueError("temperature > 0 requires a PRNG `key`")
         if deadline_s is not None and deadline_s <= 0:
@@ -460,6 +591,15 @@ class Engine:
         chooser = POLICIES[self.serve_cfg.policy]
         while self._queue and self._free_slots():
             req = self._queue[chooser(self._queue)]
+            if self._paged:
+                if not self._admit_paged(req, events):
+                    # Page pool exhausted even after cache eviction:
+                    # defer admission (the request stays queued; decode
+                    # keeps draining pages).  Deadline expiry composes
+                    # — a deferred request past its deadline leaves
+                    # through the next sweep.
+                    break
+                continue
             self._queue.remove(req)
             prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
             if self._spmd:
@@ -499,6 +639,279 @@ class Engine:
             self._tokens[j] = tok
             self._pos[j] = int(req.prompt.size)
 
+    # -------------------------------------------------------------- paged
+
+    def _copy_block(self, dst: int, src: int) -> None:
+        """Device-side page copy, every layer (COW: a partially-shared
+        tail page is duplicated before the new request's suffix
+        lands)."""
+        if self._spmd:
+            self._cache = jax.tree.map(
+                lambda s: s.at[:, dst].set(s[:, src]), self._cache)
+        else:
+            self._cache = jax.tree.map(
+                lambda s: s.at[dst].set(s[src]), self._cache)
+        self.stats.count("cow_copies")
+
+    def _install_rows(self, j: int, rows, lo: int, hi: int) -> None:
+        """Write prefill K/V rows covering positions ``lo..hi-1`` of
+        slot ``j`` into its pages.  ``rows`` is the per-layer
+        ``[{"k","v"}]`` prefill output with the row axis starting at
+        ``lo`` (a full-prompt prefill passes ``lo=0`` and may carry
+        trailing rows beyond ``hi``; they are ignored).  Installs are
+        plain ``.at[].set`` at CONCRETE page ids — exact bits, and the
+        write targets are private pages by the COW rule."""
+        bs = self.serve_cfg.block_size
+        for bi in range(lo // bs, -(-hi // bs)):
+            b = int(self._table[j, bi])
+            r0, r1 = max(lo, bi * bs), min(hi, (bi + 1) * bs)
+            o0 = r0 - bi * bs
+            if self._spmd:
+                self._cache = jax.tree.map(
+                    lambda s, r, b=b, o0=o0, r0=r0, r1=r1:
+                    s.at[:, b, o0:o0 + (r1 - r0)].set(
+                        r[:, 0, r0 - lo:r1 - lo].astype(s.dtype)),
+                    self._cache, rows)
+            else:
+                self._cache = jax.tree.map(
+                    lambda s, r, b=b, o0=o0, r0=r0, r1=r1:
+                    s.at[b, o0:o0 + (r1 - r0)].set(
+                        r[0, r0 - lo:r1 - lo].astype(s.dtype)),
+                    self._cache, rows)
+
+    def _gather_past(self, j: int, n: int):
+        """Exact-length past K/V (positions ``0..n-1``) for slot ``j``,
+        host-gathered from the pool at the slot's concrete page ids —
+        the suffix/chunk prefill input.  Stacked ``(size, 1, n, ...)``
+        leaves under SPMD, ``(1, n, ...)`` eager."""
+        bs = self.serve_cfg.block_size
+        hd = self.cfg.d_model // self.cfg.n_heads
+        kvh = self.cfg.kv_heads // self._size
+        if n == 0:
+            shape = ((self._size, 1, 0, kvh, hd) if self._spmd
+                     else (1, 0, kvh, hd))
+            z = jnp.zeros(shape, self._dtype)
+            return [{"k": z, "v": z} for _ in range(self.cfg.n_layers)]
+        nblk = -(-n // bs)
+        ids = jnp.asarray([int(self._table[j, bi])
+                           for bi in range(nblk)], jnp.int32)
+
+        def take(leaf):
+            if self._spmd:
+                g = jnp.take(leaf, ids, axis=1)
+                g = g.reshape((self._size, 1, nblk * bs) + leaf.shape[3:])
+                return g[:, :, :n]
+            g = jnp.take(leaf, ids, axis=0)
+            g = g.reshape((1, nblk * bs) + leaf.shape[2:])
+            return g[:, :n]
+
+        return [{"k": take(c["k"]), "v": take(c["v"])}
+                for c in self._cache]
+
+    def _admit_paged(self, req: Request, events: dict) -> bool:
+        """Paged admission: prefix-match the prompt against the block
+        index, adopt shared pages (COW-copying a partial tail),
+        allocate private pages for the rest, then prefill only the
+        unmatched suffix — in one shot if it fits
+        ``ServeConfig.prefill_chunk`` (or chunking is off), else as a
+        queued :class:`_PrefillJob` advanced one chunk per step.
+        Returns False (request left queued) when the pool cannot supply
+        the pages."""
+        bs = self.serve_cfg.block_size
+        prompt = np.asarray(req.prompt)
+        p_len = int(prompt.size)
+        # Cap the match at p_len - 1: admission needs last-token logits,
+        # so at least one suffix token is always computed.
+        shared, l0 = self._mgr.match(prompt, p_len - 1)
+        partial = l0 % bs != 0
+        total = -(-p_len // bs)
+        # Pages not fully covered by the share; when the tail match is
+        # partial its page sits in `shared` but must be COW-copied, and
+        # the copy target is the first of these fresh pages.
+        n_new = total - (l0 // bs)
+        fresh = self._mgr.alloc(n_new)
+        if fresh is None:
+            return False
+        self._mgr.ref(shared)
+        j = self._free_slots()[0]
+        for bi in range(l0 // bs):
+            self._table[j, bi] = shared[bi]
+        for i, bi in enumerate(range(l0 // bs, total)):
+            self._table[j, bi] = fresh[i]
+        if partial:
+            self._copy_block(fresh[0], shared[-1])
+            self._mgr.release([shared[-1]])   # keep only the copy
+        self._queue.remove(req)
+        self.stats.count("prefix_hits" if l0 else "prefix_misses")
+        self._slot_req[j] = req
+        self._slot_seq[j] = self._admit_seq
+        self._admit_seq += 1
+        self.slot_log.append((req.rid, j))
+        self._prefilling[j] = True
+        self._pos[j] = l0          # rows installed so far
+        job = _PrefillJob(req=req, slot=j, seq=prompt, done=l0)
+        if l0 == 0 and (self._chunk is None or p_len <= self._chunk):
+            # Whole-prompt miss that fits one shot: the ordinary full
+            # prefill — the IDENTICAL dispatch the dense engine and the
+            # generate() oracle use.
+            pj = jnp.asarray(prompt, jnp.int32)[None, :]
+            if self._spmd:
+                logits, rows = self._prefill_call(self._shards, pj)
+                logits_row = np.asarray(logits[0][0])
+            else:
+                cache1 = _kv.init_kv_cache_tp(
+                    self.cfg, 1, self._size, self._dtype, poison=False)
+                logits, rows = _kv.prefill_tp(
+                    self.cfg, self._shards, cache1, pj, self._comm)
+                logits_row = np.asarray(logits[0])
+            self._install_rows(j, rows, 0, p_len)
+            self.stats.count("prefill_tokens", p_len)
+            job.done = p_len
+            self._complete_admission(job, logits_row, events)
+        elif self._chunk is None or p_len - l0 <= self._chunk:
+            # Suffix fits one shot: single chunk call at admission,
+            # like the dense path (first token this step).
+            self._advance_job_chunk(job, events, cap=p_len - l0)
+        else:
+            # Long suffix: interleave — ONE chunk per step rides along
+            # with the resident slots' decode (_prefill_tick).
+            self._prefill_jobs.append(job)
+        return True
+
+    def _advance_job_chunk(self, job: _PrefillJob, events: dict,
+                           cap: Optional[int] = None) -> bool:
+        """Run ONE prefill chunk of ``job``; returns True when the
+        prompt is fully installed (first token selected, slot
+        activated)."""
+        j = job.slot
+        p_len = len(job.seq)
+        c_len = min(cap if cap is not None else self._chunk,
+                    p_len - job.done)
+        past = self._gather_past(j, job.done)
+        chunk = jnp.asarray(job.seq[job.done:job.done + c_len],
+                            jnp.int32)[None, :]
+        if self._spmd:
+            logits, rows = self._chunk_call(self._shards, past, chunk)
+            logits_row = np.asarray(logits[0][0])
+        else:
+            logits, rows = _kv.prefill_chunk_tp(
+                self.cfg, self._shards, past, chunk, self._comm)
+            logits_row = np.asarray(logits[0])
+        self._install_rows(j, rows, job.done, job.done + c_len)
+        self.stats.count("prefill_tokens", c_len)
+        job.done += c_len
+        self._pos[j] = job.done
+        if job.done == p_len:
+            self._complete_admission(job, logits_row, events)
+            return True
+        return False
+
+    def _complete_admission(self, job: _PrefillJob, logits_row,
+                            events: dict) -> None:
+        """Prompt fully resident: select the first token (the oracle's
+        key discipline), register the prompt chain for future sharers,
+        activate the slot — or finish immediately (``max_new=1`` /
+        instant EOS), releasing the pages through the registering
+        release path."""
+        req, j = job.req, job.slot
+        bs = self.serve_cfg.block_size
+        p_len = len(job.seq)
+        self.stats.mark(req.rid, "admitted")
+        self.stats.count("admitted")
+        tok = self._select(req, logits_row)
+        req.emitted.append(tok)
+        self.stats.mark(req.rid, "first_token")
+        events["admitted"].append(req.rid)
+        events["emitted"].setdefault(req.rid, []).append(tok)
+        ids = [int(self._table[j, bi]) for bi in range(-(-p_len // bs))]
+        # Content-addressed, so indexing the slot's own (immutable for
+        # its lifetime) prompt pages is safe; the next identical prompt
+        # prefills nothing but its final token.
+        self._mgr.register(job.seq, ids, p_len)
+        self._prefilling[j] = False
+        self._tokens[j] = tok
+        self._pos[j] = p_len
+        if req.finished(self.serve_cfg.eos):
+            events["finished"].append(req.rid)
+            self._release_slots([j])
+            self._finish(req)
+
+    def _prefill_tick(self, events: dict) -> None:
+        """Advance the HEAD chunked-prefill job by exactly one chunk —
+        the global per-step prefill bound that keeps TTFT and resident
+        decode latency simultaneously bounded."""
+        if not self._prefill_jobs:
+            return
+        if self._advance_job_chunk(self._prefill_jobs[0], events):
+            self._prefill_jobs.popleft()
+
+    def _preempt_one(self) -> bool:
+        """Preempt the most recently admitted resident request to free
+        pages: its written rows register in the prefix index before
+        release, then the request re-queues AT THE HEAD with its
+        emitted tokens folded into the prompt (the elastic
+        extended-prompt discipline) — re-admission prefix-matches its
+        own registered pages, so the restart costs ~one COW copy plus a
+        one-token suffix, and the stitched stream stays bitwise the
+        generate() oracle."""
+        cands = [j for j in range(self.serve_cfg.slots)
+                 if self._slot_req[j] is not None]
+        if not cands:
+            return False
+        j = max(cands, key=lambda s: self._slot_seq[s])
+        req = self._slot_req[j]
+        prompt = np.asarray(req.prompt)
+        ext = np.concatenate([prompt.astype(np.int64),
+                              np.asarray(req.emitted, np.int64)]) \
+            .astype(prompt.dtype, copy=False)
+        nreq = Request(rid=req.rid, prompt=ext,
+                       max_new=req.max_new - len(req.emitted),
+                       key=req.key, deadline=req.deadline)
+        self._release_slots([j])   # registers the chain, frees pages
+        self._queue.appendleft(nreq)
+        self.stats.count("preempted")
+        return True
+
+    def _alloc_tick(self) -> None:
+        """Lazy per-step page allocation: before decode, every active
+        slot whose write position crosses into an unmapped page gets
+        one.  On exhaustion the engine preempts (newest-admitted first)
+        until the allocation lands — the preempted victim's pages go
+        cached-then-evictable, so each round frees real capacity and
+        the loop terminates (a request too big to EVER fit is rejected
+        at submit)."""
+        bs = self.serve_cfg.block_size
+        for j in range(self.serve_cfg.slots):
+            while True:
+                req = self._slot_req[j]
+                if req is None or self._prefilling[j]:
+                    break
+                bi = int(self._pos[j]) // bs
+                if self._table[j, bi] >= 0:
+                    break
+                got = self._mgr.alloc(1)
+                if got is not None:
+                    self._table[j, bi] = got[0]
+                    break
+                if not self._preempt_one():
+                    break
+
+    def kv_bytes_resident(self) -> int:
+        """Deterministic KV-residency census (one rank's shard): bytes
+        of cache RESERVED for request state right now — the dense
+        engine holds every occupied slot's full ``max_seq`` rows, the
+        paged engine only its in-use pages (a shared prefix counted
+        once).  The bench occupancy stanza's headline integrates this
+        per step; it is a census, not a timer, so it regresses
+        deterministically on CPU smoke."""
+        hd = self.cfg.d_model // self.cfg.n_heads
+        row = 2 * (self.cfg.kv_heads // self._size) * hd \
+            * self.cfg.n_layers * jnp.dtype(self._dtype).itemsize
+        if self._paged:
+            return self._mgr.blocks_in_use \
+                * self.serve_cfg.block_size * row
+        return self.occupancy() * self.cfg.max_seq * row
+
     def _finish(self, req: Request, status: str = STATUS_OK) -> None:
         self._results[req.rid] = np.concatenate(
             [np.asarray(req.prompt, np.int64),
@@ -513,6 +926,41 @@ class Engine:
         accidentally plausible.  Shared by eviction and the elastic
         drain so the poisoning convention has a single home."""
         if not idxs:
+            return
+        if self._paged:
+            bs = self.serve_cfg.block_size
+            for j in idxs:
+                req = self._slot_req[j]
+                if req is not None:
+                    # Register the written rows (prompt + emitted up to
+                    # the write frontier) before letting the pages go:
+                    # eviction, drain and preemption all leave the
+                    # prefix index able to hand the SAME pages back to
+                    # a re-admission — blocks-intact by content hash.
+                    n = int(self._pos[j])
+                    seq = np.concatenate(
+                        [np.asarray(req.prompt, np.int64),
+                         np.asarray(req.emitted, np.int64)])[:n]
+                    if n:
+                        ids = [int(self._table[j, bi])
+                               for bi in range(-(-n // bs))]
+                        self._mgr.register(seq, ids, n)
+                    held = [int(b) for b in self._table[j] if b >= 0]
+                    self._mgr.release(held)
+                    self._table[j, :] = -1
+                if self._prefilling[j]:
+                    self._prefilling[j] = False
+                    self._prefill_jobs = deque(
+                        job for job in self._prefill_jobs
+                        if job.slot != j)
+            for j in idxs:
+                self._slot_req[j] = None
+                self._tokens[j] = 0
+                self._pos[j] = 0
+            # No NaN poison: free pages are simply unmapped (-1 table
+            # entries); block_gather masks them to zero and the causal
+            # frontier keeps stale mapped rows inert — same invariant,
+            # enforced by masking instead of poison.
             return
         for j in idxs:
             self._slot_req[j] = None
@@ -571,16 +1019,40 @@ class Engine:
                   "expired": []}
         self._expire_sweep(events)
         self._admit(events)
+        if self._paged:
+            self._prefill_tick(events)
+            self._alloc_tick()
         active = [j for j, r in enumerate(self._slot_req)
-                  if r is not None]
+                  if r is not None and not self._prefilling[j]]
         if not active:
+            if self._paged:
+                self._pool_levels()
             return events
-        live = np.asarray([r is not None for r in self._slot_req])
+        live = np.asarray([self._slot_req[j] is not None
+                           and not self._prefilling[j]
+                           for j in range(self.serve_cfg.slots)])
         if self._spmd:
-            logits, self._cache = self._step_call(
-                self._shards, self._cache, jnp.asarray(self._tokens),
-                jnp.asarray(self._pos), jnp.asarray(live))
+            if self._paged:
+                logits, self._cache = self._step_call(
+                    self._shards, self._cache,
+                    jnp.asarray(self._table),
+                    jnp.asarray(self._tokens),
+                    jnp.asarray(self._pos), jnp.asarray(live))
+            else:
+                logits, self._cache = self._step_call(
+                    self._shards, self._cache,
+                    jnp.asarray(self._tokens),
+                    jnp.asarray(self._pos), jnp.asarray(live))
             table = np.asarray(logits[0])
+        elif self._paged:
+            logits, self._cache = _kv.decode_step_paged(
+                self.cfg, self._shards, self._cache,
+                jnp.asarray(self._table), jnp.asarray(self._tokens),
+                jnp.asarray(self._pos), self._comm,
+                overlap=self.serve_cfg.overlap,
+                algorithm=self.serve_cfg.algorithm,
+                active=jnp.asarray(live))
+            table = np.asarray(logits)
         else:
             logits, self._cache = _kv.decode_step_tp(
                 self.cfg, self._shards, self._cache,
@@ -601,7 +1073,18 @@ class Engine:
             if req.finished(self.serve_cfg.eos):
                 events["finished"].append(req.rid)
                 self._evict(j)
+        if self._paged:
+            self._pool_levels()
         return events
+
+    def _pool_levels(self) -> None:
+        """Mirror the block pool's population into the gauge-semantics
+        ServeStats counters (and, through the registered serve
+        collector, into the ``mpi4torch_serve_*`` obs metrics) at the
+        end of every step."""
+        self.stats.level("blocks_in_use", self._mgr.blocks_in_use)
+        self.stats.level("blocks_free", self._mgr.free_blocks)
+        self.stats.level("blocks_cached", self._mgr.cached_blocks)
 
     def run(self, max_steps: Optional[int] = None) -> Dict[Any, np.ndarray]:
         """Drive :meth:`step` until every submitted request finished
@@ -646,17 +1129,33 @@ class Engine:
         """Host-side snapshot of every unfinished request (queued and
         slotted), in slot order then queue order — the drain payload of
         the elastic runtime (mpi4torch_tpu.elastic.replan)."""
-        recs = []
-        for req in self._slot_req:
+        recs, pages = [], {}
+        for j, req in enumerate(self._slot_req):
             if req is not None:
                 recs.append(req)
+                if self._paged:
+                    # Block-table state rides the drain record: which
+                    # pages held this request's written rows, and how
+                    # many.  Re-admission into the same pool recovers
+                    # them through the content-addressed prefix index
+                    # (the registering _release_slots), so the ticket's
+                    # copy is the EXPLICIT form of what the hash chain
+                    # guarantees — drained paged requests re-admit with
+                    # their prefix-shared pages intact.
+                    n = int(self._pos[j])
+                    bs = self.serve_cfg.block_size
+                    pages[id(req)] = {
+                        "block_ids": [int(self._table[j, bi])
+                                      for bi in range(-(-n // bs))],
+                        "n_tokens": n}
         recs.extend(self._queue)
         return [{"rid": r.rid,
                  "prompt": np.array(r.prompt, copy=True),
                  "emitted": list(r.emitted),
                  "max_new": r.max_new,
                  "key": r.key,
-                 "deadline": r.deadline} for r in recs]
+                 "deadline": r.deadline,
+                 "pages": pages.get(id(r))} for r in recs]
 
     def snapshot_inflight(self) -> List[dict]:
         """Non-destructive :meth:`drain`: the same records, with the
@@ -697,6 +1196,13 @@ class Engine:
                 "construct the engine with spmd=True")
         live = jnp.asarray(
             [r is not None for r in self._slot_req])
+        if self._paged:
+            # The block table is an ARGUMENT: two different table
+            # states lower to the identical program text (the no-retrace
+            # census in `make serve-smoke` holds exactly this).
+            return jax.jit(self._step_call).lower(
+                self._shards, self._cache, jnp.asarray(self._table),
+                jnp.asarray(self._tokens), jnp.asarray(self._pos), live)
         return jax.jit(self._step_call).lower(
             self._shards, self._cache, jnp.asarray(self._tokens),
             jnp.asarray(self._pos), live)

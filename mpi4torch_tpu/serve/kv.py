@@ -51,7 +51,7 @@ from .. import config as _config
 from ..constants import MPI_SUM
 from ..models.transformer import TransformerConfig, _norm, _rope_rotate
 from ..ops.flash import flash_attention, flash_block_attention
-from ..ops.ragged import position_onehot
+from ..ops.ragged import block_gather, block_scatter, position_onehot
 from ..overlap import overlap_split_allreduce, resolve_overlap
 from ..parallel.tp import shard_axis, shard_heads
 from ..runtime import CommError
@@ -61,8 +61,11 @@ __all__ = [
     "validate_tp",
     "shard_params_tp",
     "init_kv_cache_tp",
+    "init_kv_pool_tp",
     "prefill_tp",
+    "prefill_chunk_tp",
     "decode_step_tp",
+    "decode_step_paged",
     "admit_zero3",
 ]
 
@@ -181,6 +184,36 @@ def init_kv_cache_tp(cfg: TransformerConfig, slots: int, size: int,
     return [{"k": buf, "v": buf} for _ in range(cfg.n_layers)]
 
 
+def init_kv_pool_tp(cfg: TransformerConfig, num_blocks: int,
+                    block_size: int, size: int, dtype=jnp.float32):
+    """Per-layer TP-sharded paged KV pool:
+    ``(num_blocks, block_size, kv_heads / size, head_dim)`` per rank —
+    the paged counterpart of :func:`init_kv_cache_tp`, addressed
+    through a per-slot block table instead of a dense per-slot row.
+    One block-id space serves every layer (block ``i`` of each layer is
+    the same logical page, so one table drives all layers' gathers).
+
+    ``block_size`` must divide ``cfg.max_seq``: the decode step gathers
+    each slot's pages back into a full ``max_seq`` extent, so the paged
+    attention sees exactly the dense buffer shape (unmapped pages as
+    inert zero rows behind the causal frontier) — that extent equality
+    is part of the bitwise-parity contract with the dense path.
+
+    No poison fill: free state is expressed by table entries (``-1``),
+    and :func:`~mpi4torch_tpu.ops.ragged.block_gather` zeroes unmapped
+    pages — a stale page's bits are unreachable without a table entry
+    pointing at it."""
+    if block_size < 1 or cfg.max_seq % block_size != 0:
+        raise CommError(
+            f"serve: block_size={block_size} must be >= 1 and divide "
+            f"max_seq={cfg.max_seq} (the paged gather reconstructs the "
+            "dense attention extent)")
+    hd = cfg.d_model // cfg.n_heads
+    shape = (num_blocks, block_size, cfg.kv_heads // size, hd)
+    buf = jnp.zeros(shape, dtype)
+    return [{"k": buf, "v": buf} for _ in range(cfg.n_layers)]
+
+
 def _tp_size(cfg: TransformerConfig, shards) -> int:
     """The TP world size a shard tree was built for, read off the
     output projection's row count (``h_local * head_dim``) — so the
@@ -283,6 +316,64 @@ def prefill_tp(cfg: TransformerConfig, shards, cache, prompt, comm=None):
         return x[:, -1] @ shards["unembed"], new_cache
 
 
+def prefill_chunk_tp(cfg: TransformerConfig, shards, past, chunk,
+                     comm=None):
+    """TP prefill of one prompt CHUNK against already-computed prefix
+    K/V: the suffix/chunked half of paged admission.  ``chunk`` is
+    ``(1, c_len)`` tokens occupying global positions ``p_len ..
+    p_len + c_len - 1`` where ``p_len`` is read off ``past`` — a
+    per-layer ``[{"k", "v"}]`` list of EXACT-length ``(1, p_len, ...)``
+    prefix rows (``p_len = 0`` arrays make this a from-scratch prefill
+    of the same math as :func:`prefill_tp`).  Returns ``(last_logits,
+    chunk_rows)`` with ``chunk_rows`` the chunk's own K/V in ``past``'s
+    dtype, ready to install into the page pool.
+
+    Bitwise contract: the chunk's rows attend ``[past ++ chunk]``
+    through the same jnp attention path as the full prefill with the
+    matching global ``q_offset``, so row ``i`` of a chunked prefill
+    carries the bits row ``i`` of the one-shot prefill would — prompt
+    rows depend only on the tokens at or before them (causal masking),
+    which is the fact prefix SHARING rides: a prefix prefilled under
+    one request is bit-valid for every request extending it.  Exactness
+    requires ``past`` to carry the compute dtype (the engine gates
+    prefix sharing and chunking on ``cache_dtype == param dtype``; a
+    down-cast cache would re-quantize the prefix rows the one-shot
+    oracle keeps at full precision).
+
+    Collectives are the blocking prefill path (compute-bound phase,
+    outside the decode exposure census), one per row-parallel half."""
+    b, c_len = chunk.shape
+    p_len = int(past[0]["k"].shape[1])
+    size = _tp_size(cfg, shards)
+    x = shards["embed"][chunk]
+    if not cfg.rope:
+        x = x + shards["pos"][None, p_len:p_len + c_len]
+    positions = jnp.arange(p_len, p_len + c_len, dtype=jnp.int32)
+    rows = []
+    with serve_step_scope("prefill"):
+        for blk, p in zip(shards["blocks"], past):
+            y = _norm(cfg, x, blk["ln1"])
+            q, k, v = _split_qkv_local(cfg, blk, y, positions, size)
+            rows.append({"k": k.astype(p["k"].dtype),
+                         "v": v.astype(p["v"].dtype)})
+            kf = jnp.concatenate([p["k"].astype(k.dtype), k], axis=1)
+            vf = jnp.concatenate([p["v"].astype(v.dtype), v], axis=1)
+            o, _ = flash_block_attention(
+                q, kf, vf, causal=True, q_offset=p_len, kv_offset=0,
+                window=cfg.attn_window, impl="jnp")
+            o_part = o.reshape(b, c_len, -1) @ blk["wo"]
+            if comm is not None:
+                o_part = comm.Allreduce(o_part, MPI_SUM,
+                                        compression=False)
+            x = x + o_part.astype(x.dtype)
+            ff = _ffn_local(cfg, blk, _norm(cfg, x, blk["ln2"]))
+            if comm is not None:
+                ff = comm.Allreduce(ff, MPI_SUM, compression=False)
+            x = x + ff.astype(x.dtype)
+        x = _norm(cfg, x, shards["ln_f"])
+        return x[:, -1] @ shards["unembed"], rows
+
+
 def decode_step_tp(cfg: TransformerConfig, shards, cache, tokens, pos,
                    comm=None, *, overlap=None,
                    algorithm: Optional[str] = None, active=None):
@@ -370,6 +461,94 @@ def decode_step_tp(cfg: TransformerConfig, shards, cache, tokens, pos,
             x = x + ff.astype(x.dtype)
         x = _norm(cfg, x, shards["ln_f"])
         return x @ shards["unembed"], new_cache
+
+
+def decode_step_paged(cfg: TransformerConfig, shards, pool, table,
+                      tokens, pos, comm=None, *, overlap=None,
+                      algorithm: Optional[str] = None, active=None):
+    """One continuous-batching decode step over a PAGED slot table:
+    :func:`decode_step_tp`'s exact math with the dense per-slot cache
+    replaced by ``pool`` (per-layer ``(num_blocks, block_size,
+    kv_heads/size, head_dim)`` pages, :func:`init_kv_pool_tp`) plus a
+    ``(slots, max_seq/block_size)`` block ``table`` (``-1`` =
+    unmapped).  Returns ``(logits, new_pool)``.
+
+    Per layer: the new K/V row lands by
+    :func:`~mpi4torch_tpu.ops.ragged.block_scatter` one-hot write into
+    the slot's current page (``table[s, pos[s]//bs]`` at offset
+    ``pos[s] % bs``), then :func:`~mpi4torch_tpu.ops.ragged.
+    block_gather` reconstructs each slot's full ``max_seq`` extent —
+    written rows bit-identical to the dense cache's, unmapped pages as
+    zeros behind the per-row causal frontier — and attention proceeds
+    exactly as the dense step.  The table rides as DATA: one compiled
+    program for every alloc/free/COW/prefix-sharing state of the pool,
+    the same no-retrace contract the dense slot table holds, now
+    holding under page churn too.
+
+    The caller (the engine's host-side
+    :class:`~mpi4torch_tpu.serve.paging.BlockManager`) guarantees live
+    slots' write cells are distinct private pages — the copy-on-write
+    discipline — which is ``block_scatter``'s exactness invariant.
+    Free slots carry ``-1`` write pages and an ``active=False`` mask:
+    no write, zero gathered rows, payload rows zeroed before the wire
+    (same ``guard_rows`` rule as the dense step)."""
+    slots = tokens.shape[0]
+    pos = jnp.asarray(pos, jnp.int32)
+    table = jnp.asarray(table, jnp.int32)
+    size = _tp_size(cfg, shards)
+    ov = resolve_overlap(overlap)
+    nsites = 2 * len(shards["blocks"])
+    bs = pool[0]["k"].shape[1]
+    n_blk = table.shape[1]
+    live_vec = None if active is None \
+        else jnp.asarray(active).astype(bool)
+    live = None if live_vec is None else live_vec[:, None]
+
+    def guard_rows(payload):
+        if live is None:
+            return payload
+        return jnp.where(live, payload, jnp.zeros((), payload.dtype))
+
+    # The slot's current write page and in-page offset; a free slot's
+    # all--1 table row yields -1, which block_scatter drops.
+    wb = jnp.take_along_axis(
+        table, jnp.clip(pos // bs, 0, n_blk - 1)[:, None], axis=1)[:, 0]
+    off = pos % bs
+
+    with serve_step_scope("decode_step"):
+        x = shards["embed"][tokens]
+        if not cfg.rope:
+            x = x + jnp.take(shards["pos"], pos, axis=0)
+        site = 0
+        new_pool = []
+        for blk, c in zip(shards["blocks"], pool):
+            y = _norm(cfg, x, blk["ln1"])
+            q, k_new, v_new = _split_qkv_local(
+                cfg, blk, y[:, None, :], pos[:, None], size)
+            pk = block_scatter(c["k"], wb, off, k_new[:, 0],
+                               active=live_vec)
+            pv = block_scatter(c["v"], wb, off, v_new[:, 0],
+                               active=live_vec)
+            new_pool.append({"k": pk, "v": pv})
+            ck = block_gather(pk, table)
+            cv = block_gather(pv, table)
+            o, _ = flash_block_attention(
+                q, ck, cv, causal=True, q_offset=pos, kv_offset=0,
+                window=cfg.attn_window, impl="jnp")
+            o_part = o.reshape(slots, -1).astype(x.dtype) @ blk["wo"]
+            attn = _decode_allreduce(comm, guard_rows(o_part), site=site,
+                                     nsites=nsites, overlap=ov,
+                                     algorithm=algorithm)
+            site += 1
+            x = x + attn.astype(x.dtype)
+            ff = _ffn_local(cfg, blk, _norm(cfg, x, blk["ln2"]))
+            ff = _decode_allreduce(comm, guard_rows(ff), site=site,
+                                   nsites=nsites,
+                                   overlap=ov, algorithm=algorithm)
+            site += 1
+            x = x + ff.astype(x.dtype)
+        x = _norm(cfg, x, shards["ln_f"])
+        return x @ shards["unembed"], new_pool
 
 
 def admit_zero3(cfg: TransformerConfig, comm, p_shards, template, *,

@@ -122,7 +122,21 @@ class ServeStats:
                  "decode_tokens", "occupancy_ticks", "slot_ticks",
                  # ISSUE 15: typed non-ok completions — deadline-expired
                  # evictions and shed-policy queue evictions.
-                 "deadline_expired", "shed")
+                 "deadline_expired", "shed",
+                 # ISSUE 17: paged KV cache.  prefix_hits/misses count
+                 # admissions that did/didn't reuse indexed prefix
+                 # pages; prefill_tokens counts tokens actually run
+                 # through prefill (the prefix-sharing census: reused
+                 # prefix tokens never re-enter it); cow_copies and
+                 # preempted count copy-on-write page copies and
+                 # pool-pressure slot preemptions.  blocks_in_use /
+                 # blocks_free / blocks_cached are LEVELS (absolute
+                 # pool occupancy re-set each step via :meth:`level`,
+                 # not monotonic counts) riding the same mirrored
+                 # namespace.
+                 "prefix_hits", "prefix_misses", "prefill_tokens",
+                 "cow_copies", "preempted",
+                 "blocks_in_use", "blocks_free", "blocks_cached")
     SPAN_CAP = 1024
 
     def __init__(self):
@@ -141,6 +155,16 @@ class ServeStats:
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def level(self, name: str, value) -> None:
+        """Set a gauge-semantics counter to an ABSOLUTE value (the
+        paged engine's pool occupancy levels: blocks_in_use /
+        blocks_free / blocks_cached, re-set every step).  Levels ride
+        the same counters dict so the aggregate, reset, and obs
+        mirroring cover them for free; :func:`serve_stats` summing
+        across engines turns per-engine levels into fleet totals."""
+        with self._lock:
+            self.counters[name] = int(value)
 
     def tick(self, active: int, slots: int) -> None:
         """One decode step over a ``slots``-slot table with ``active``

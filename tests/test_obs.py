@@ -215,6 +215,30 @@ class TestMetricsRegistry:
         assert "serve" in snap["collected"]
         assert "n_engines" in snap["collected"]["serve"]
 
+    def test_every_serve_counter_mirrors_as_metric(self):
+        # ISSUE 17 satellite: the paging counters (prefix_hits,
+        # cow_copies, blocks_in_use, ...) must reach the exposition
+        # like every other ServeStats counter — registry-sync, not a
+        # hand-picked subset, so a new counter cannot ship unmirrored.
+        from mpi4torch_tpu import serve
+        from mpi4torch_tpu.utils.profiling import (ServeStats,
+                                                   _register_serve_stats)
+
+        serve.reset_stats()
+        s = _register_serve_stats(ServeStats())
+        for name in ServeStats._COUNTERS:
+            s.count(name, 0)
+        try:
+            text = obs.prometheus_text()
+            for name in ServeStats._COUNTERS:
+                assert f"mpi4torch_serve_{name} " in text, name
+            for paging in ("prefix_hits", "cow_copies", "preempted",
+                           "blocks_in_use", "blocks_free",
+                           "blocks_cached"):
+                assert paging in ServeStats._COUNTERS
+        finally:
+            serve.reset_stats()
+
     def test_percentile_matches_bench_rule(self):
         vals = [5.0, 1.0, 3.0, 2.0, 4.0]
         # bench's historical rule: sorted[min(int(q*n), n-1)]

@@ -13,7 +13,8 @@ import pytest
 
 import mpi4torch_tpu as mpi
 from mpi4torch_tpu import COMM_WORLD as comm
-from mpi4torch_tpu.ops import (ragged_allgather, ragged_alltoall,
+from mpi4torch_tpu.ops import (block_gather, block_scatter,
+                               ragged_allgather, ragged_alltoall,
                                ragged_gather, ragged_scatter, segment_mask)
 
 NR = 4
@@ -374,3 +375,133 @@ class TestRaggedGatherScatter:
             return True
 
         assert all(mpi.run_ranks(body, NR))
+
+
+# ---------------------------------------------------------------------------
+# Paged KV-pool primitives (ISSUE 17): block_gather / block_scatter.
+# Pure single-device ops — the serving engine drives them through the
+# block table; here they are pinned standalone.
+# ---------------------------------------------------------------------------
+
+
+def _pool(nb=5, bs=3, feat=(2,), dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((nb, bs) + feat).astype(dtype)
+
+
+class TestBlockGather:
+    def test_oracle_concat(self):
+        pool = _pool()
+        table = np.array([[2, 0], [4, 4]], np.int32)
+        got = np.asarray(block_gather(pool, table))
+        want = np.stack([np.concatenate([pool[2], pool[0]]),
+                         np.concatenate([pool[4], pool[4]])])
+        np.testing.assert_array_equal(got, want)
+
+    def test_unmapped_tail_blocks_inert(self):
+        # -1 entries (the engine's free convention) come back as ZERO
+        # pages — even when the pool holds NaN poison elsewhere, the
+        # padded tail must be inert, not plausible.
+        pool = _pool()
+        pool[3] = np.nan
+        table = np.array([[1, -1, -1]], np.int32)
+        got = np.asarray(block_gather(pool, table))
+        np.testing.assert_array_equal(got[0, :3], pool[1])
+        np.testing.assert_array_equal(got[0, 3:], 0.0)
+
+    def test_dtype_preserved_bitwise(self):
+        for dtype in (np.float16, np.float32, np.int32):
+            pool = (np.arange(5 * 3 * 2).reshape(5, 3, 2) * 7 + 1) \
+                .astype(dtype)
+            got = np.asarray(block_gather(pool, np.array([[4, 2]])))
+            assert got.dtype == dtype
+            np.testing.assert_array_equal(
+                got[0], np.concatenate([pool[4], pool[2]]))
+
+    def test_table_is_data_not_structure(self):
+        # One compiled program for EVERY table state — the no-retrace
+        # contract the serving decode step rides on.
+        pool = _pool()
+        f = jax.jit(block_gather)
+        t1 = np.array([[0, 1]], np.int32)
+        t2 = np.array([[3, -1]], np.int32)
+        np.testing.assert_array_equal(np.asarray(f(pool, t1)),
+                                      np.asarray(block_gather(pool, t1)))
+        np.testing.assert_array_equal(np.asarray(f(pool, t2)),
+                                      np.asarray(block_gather(pool, t2)))
+        assert f._cache_size() == 1
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError, match="pool"):
+            block_gather(jnp.zeros((4,)), np.zeros((1, 1), np.int32))
+        with pytest.raises(ValueError, match="table"):
+            block_gather(jnp.zeros((4, 2)), np.zeros((3,), np.int32))
+
+
+class TestBlockScatter:
+    def test_one_hot_write_at_block_granularity(self):
+        pool = _pool()
+        out = np.asarray(block_scatter(
+            pool, np.array([3, 1]), np.array([0, 2]),
+            np.array([[10.0, 11.0], [20.0, 21.0]], np.float32)))
+        want = pool.copy()
+        want[3, 0] = [10.0, 11.0]
+        want[1, 2] = [20.0, 21.0]
+        np.testing.assert_array_equal(out, want)
+
+    def test_negative_or_oob_targets_write_nothing(self):
+        pool = _pool()
+        vals = np.full((3, 2), 99.0, np.float32)
+        out = np.asarray(block_scatter(
+            pool, np.array([-1, 7, 2]), np.array([0, 1, 9]), vals))
+        np.testing.assert_array_equal(out, pool)
+
+    def test_active_mask_suppresses_writer(self):
+        pool = _pool()
+        vals = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out = np.asarray(block_scatter(
+            pool, np.array([0, 1]), np.array([0, 0]), vals,
+            active=np.array([False, True])))
+        want = pool.copy()
+        want[1, 0] = [3.0, 4.0]
+        np.testing.assert_array_equal(out, want)
+
+    def test_untouched_cells_bitwise_unchanged(self):
+        # `where`-routed, never summed: a write elsewhere must not
+        # perturb (or de-NaN) any other cell by a single bit.
+        pool = _pool()
+        pool[0, 0] = -0.0
+        pool[2, 1] = np.nan
+        out = np.asarray(block_scatter(
+            pool, np.array([4]), np.array([2]),
+            np.array([[5.0, 6.0]], np.float32)))
+        np.testing.assert_array_equal(out[4, 2], [5.0, 6.0])
+        assert np.signbit(out[0, 0]).all()
+        assert np.isnan(out[2, 1]).all()
+
+    def test_dtype_cast_to_pool(self):
+        pool = _pool(dtype=np.float16)
+        out = block_scatter(pool, np.array([1]), np.array([1]),
+                            jnp.asarray([[1.5, 2.5]], jnp.float32))
+        assert out.dtype == jnp.float16
+        np.testing.assert_array_equal(np.asarray(out[1, 1]), [1.5, 2.5])
+
+    def test_feature_shape_validation(self):
+        with pytest.raises(ValueError, match="feature"):
+            block_scatter(jnp.zeros((4, 2, 3)), np.array([0]),
+                          np.array([0]), jnp.zeros((1, 5)))
+
+    def test_scatter_then_gather_roundtrip(self):
+        # The decode step's exact composition: write one row per slot,
+        # gather each slot's pages back — the written row must come
+        # back bit-identical through the table.
+        pool = _pool(nb=6, bs=2)
+        table = np.array([[0, 3], [5, 1]], np.int32)
+        vals = np.array([[7.0, 8.0], [9.0, 10.0]], np.float32)
+        # slot 0 writes position 3 (page table[0,1]=3, offset 1);
+        # slot 1 writes position 0 (page table[1,0]=5, offset 0).
+        out = block_scatter(pool, np.array([3, 5]), np.array([1, 0]),
+                            vals)
+        g = np.asarray(block_gather(out, table))
+        np.testing.assert_array_equal(g[0, 3], vals[0])
+        np.testing.assert_array_equal(g[1, 0], vals[1])

@@ -699,3 +699,427 @@ class TestDeadlinesAndShedding:
         eng.pop_results()
         assert eng.status(0) is None
         assert eng.statuses() == {}
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache: block-table paging, COW prefix sharing, chunked
+# prefill (ISSUE 17).
+# ---------------------------------------------------------------------------
+
+# Tight pool: 4 requests' pages churn through it (dense-equivalent
+# would be slots * max_seq / bs = 12 pages; 5 forces reuse + cached-
+# page eviction).  bs=4 divides CFG.max_seq=24.
+PAGED_TIGHT = dict(slots=2, block_size=4, num_blocks=5)
+
+
+class TestPagedOracleParity:
+    """Bitwise token parity vs per-request generate() with the KV cache
+    paged — across block churn (tight pool), every policy, Mode A and
+    Mode B, greedy and sampled."""
+
+    @pytest.mark.parametrize("policy", sorted(serve.POLICIES))
+    def test_local_churn_matrix(self, policy):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(policy=policy,
+                                             **PAGED_TIGHT))
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
+    def test_gqa_rope_swiglu_variants(self):
+        for cfg in (CFG_GQA, CFG_ROPE, CFG_SWIGLU):
+            params = _params(cfg)
+            eng = serve.Engine(cfg, params,
+                               serve.ServeConfig(**PAGED_TIGHT))
+            assert_matches_oracle(cfg, params, drive(eng))
+
+    @pytest.mark.slow  # serve-smoke carries the paged Mode A (4,) parity cell
+    def test_spmd_world4_overlap(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(overlap=True,
+                                             **PAGED_TIGHT),
+                           spmd=True, nranks=4)
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
+    def test_spmd_mesh_2x4(self):
+        params = _params(CFG)
+        mesh = mpi.device_mesh({"dp": 2, "tp": 4})
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(overlap=True,
+                                             **PAGED_TIGHT),
+                           spmd=True, mesh=mesh, axis_name="tp")
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    @pytest.mark.slow  # heavyweight compile/run; TPU-manual lane (tier-1 budget)
+    def test_ranks_world4_mode_b(self):
+        params = _params(CFG)
+
+        def fn(rank):
+            eng = serve.Engine(CFG, params,
+                               serve.ServeConfig(overlap=True,
+                                                 **PAGED_TIGHT))
+            return drive(eng)
+
+        outs = mpi.run_ranks(fn, 4, timeout=120.0)
+        # Identical deterministic host decisions on every rank keep
+        # the block tables in lock-step under the decode collectives.
+        for r in range(1, 4):
+            for i in range(len(PROMPTS)):
+                np.testing.assert_array_equal(outs[r][i], outs[0][i])
+        assert_matches_oracle(CFG, params, outs[0])
+
+    def test_sampled_parity_local(self):
+        params = _params(CFG)
+        keys = [jax.random.PRNGKey(100 + i) for i in range(len(PROMPTS))]
+        eng = serve.Engine(
+            CFG, params,
+            serve.ServeConfig(temperature=0.9, top_k=7, **PAGED_TIGHT))
+        res = drive(eng, keys=keys)
+        assert_matches_oracle(CFG, params, res, temperature=0.9,
+                              top_k=7, keys=keys)
+
+    def test_preemption_under_pool_pressure(self):
+        # 3 pages for two slots whose requests need 2 pages each: the
+        # second admission eventually starves the first of a decode
+        # page — the newest-admitted is preempted, requeued with its
+        # emitted tokens folded into the prompt, and the STITCHED
+        # stream stays bitwise the oracle.
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4,
+                                             num_blocks=3))
+        eng.submit(PROMPTS[0], max_new=6)   # 3+6-1=8 rows -> 2 pages
+        eng.submit(PROMPTS[1], max_new=4)   # 5+4-1=8 rows -> 2 pages
+        res = eng.run()
+        for i, n in ((0, 6), (1, 4)):
+            np.testing.assert_array_equal(
+                res[i], oracle_tokens(CFG, params, PROMPTS[i], n))
+        assert eng.stats.snapshot()["preempted"] >= 1
+
+    def test_deadline_evictions_compose(self):
+        # PR 15 deadline path on the paged engine: the expired request
+        # keeps an oracle PREFIX, survivors stay bitwise, pages return
+        # to the pool.
+        t = [0.0]
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(**PAGED_TIGHT),
+                           clock=lambda: t[0])
+        eng.submit(PROMPTS[0], max_new=6, deadline_s=2.5)
+        eng.submit(PROMPTS[1], max_new=4)
+        while eng.pending():
+            eng.step()
+            t[0] += 1.0
+        res = eng.results()
+        assert eng.status(0) == serve.STATUS_EXPIRED
+        want0 = oracle_tokens(CFG, params, PROMPTS[0], 6)
+        got0 = np.asarray(res[0])
+        np.testing.assert_array_equal(got0, want0[:len(got0)])
+        assert len(got0) < len(want0)
+        np.testing.assert_array_equal(
+            res[1], oracle_tokens(CFG, params, PROMPTS[1], 4))
+        assert eng._mgr.blocks_in_use == 0
+
+
+class TestPrefixSharing:
+    def test_shared_prefix_prefilled_once_same_pages(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4))
+        sys_p = np.arange(1, 9)                  # 8 tokens = 2 pages
+        pa = np.concatenate([sys_p, [20, 21]])
+        pb = np.concatenate([sys_p, [22]])
+        ra = eng.submit(pa, max_new=4)
+        rb = eng.submit(pb, max_new=4)
+        eng.step()                # both admitted: tables live now
+        sa = [s for r, s in eng.slot_log if r == ra][0]
+        sb = [s for r, s in eng.slot_log if r == rb][0]
+        shared = list(eng._table[sb][:2])
+        assert list(eng._table[sa][:2]) == shared
+        assert min(shared) >= 0
+        res = eng.run()
+        np.testing.assert_array_equal(
+            res[ra], oracle_tokens(CFG, params, pa, 4))
+        np.testing.assert_array_equal(
+            res[rb], oracle_tokens(CFG, params, pb, 4))
+        snap = eng.stats.snapshot()
+        # The census: the 8 shared tokens prefill ONCE.
+        assert snap["prefill_tokens"] == len(pa) + (len(pb) - 8)
+        assert snap["prefix_hits"] == 1
+        assert snap["prefix_misses"] == 1
+
+    def test_partial_tail_hit_is_cow_copied(self):
+        # pa's 6-token prompt with bs=4 REGISTERS as one full page plus
+        # a 2-row partial tail (a full-page chain cannot represent it).
+        # pb extends that exact prefix, so its match lands mid-page on
+        # the tail — which must be COPIED before pb's suffix rows hit
+        # it (never written in place: pa still attends those rows).
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4))
+        pa = np.arange(1, 7)                     # 6 tokens
+        pb = np.concatenate([pa, [22, 23]])
+        ra = eng.submit(pa, max_new=4)
+        rb = eng.submit(pb, max_new=4)
+        eng.step()
+        # Shared FULL page identical; tail pages distinct (the copy).
+        sa = [s for r, s in eng.slot_log if r == ra][0]
+        sb = [s for r, s in eng.slot_log if r == rb][0]
+        assert eng._table[sa][0] == eng._table[sb][0] >= 0
+        assert eng._table[sa][1] != eng._table[sb][1]
+        res = eng.run()
+        np.testing.assert_array_equal(
+            res[ra], oracle_tokens(CFG, params, pa, 4))
+        np.testing.assert_array_equal(
+            res[rb], oracle_tokens(CFG, params, pb, 4))
+        snap = eng.stats.snapshot()
+        assert snap["cow_copies"] >= 1
+        assert snap["prefix_hits"] == 1
+
+    def test_prefix_cache_off_still_bitwise(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4,
+                                             prefix_cache=False))
+        sys_p = np.arange(1, 9)
+        pa = np.concatenate([sys_p, [20]])
+        pb = np.concatenate([sys_p, [21]])
+        eng.submit(pa, max_new=3)
+        eng.submit(pb, max_new=3)
+        res = eng.run()
+        np.testing.assert_array_equal(
+            res[0], oracle_tokens(CFG, params, pa, 3))
+        np.testing.assert_array_equal(
+            res[1], oracle_tokens(CFG, params, pb, 3))
+        snap = eng.stats.snapshot()
+        assert snap["prefix_hits"] == 0
+        assert snap["prefill_tokens"] == len(pa) + len(pb)
+
+    def test_cache_dtype_gate_disables_sharing_not_paging(self):
+        # A down-cast cache would re-quantize shared rows the oracle
+        # keeps at compute precision: the exactness gate turns the
+        # prefix index (and chunking) off while paging stays on.
+        params = _params(CFG)
+        eng = serve.Engine(
+            CFG, params,
+            serve.ServeConfig(slots=2, block_size=4,
+                              cache_dtype=jnp.bfloat16))
+        assert eng._paged
+        assert not eng._mgr.prefix_cache
+        assert eng._chunk is None
+        sys_p = np.arange(1, 9)
+        eng.submit(np.concatenate([sys_p, [20]]), max_new=2)
+        eng.submit(np.concatenate([sys_p, [21]]), max_new=2)
+        eng.run()
+        assert eng.stats.snapshot()["prefix_hits"] == 0
+
+
+class TestChunkedPrefill:
+    @pytest.mark.parametrize("chunk", [
+        1, 3,
+        # block-aligned + oversize chunks ride the TPU-manual lane
+        # (tier-1 budget); 1 and 3 cover the mid-page boundary cases.
+        pytest.param(4, marks=pytest.mark.slow),
+        pytest.param(7, marks=pytest.mark.slow),
+    ])
+    def test_chunked_prefill_bitwise(self, chunk):
+        params = _params(CFG)
+        eng = serve.Engine(
+            CFG, params,
+            serve.ServeConfig(slots=2, block_size=4,
+                              prefill_chunk=chunk))
+        assert_matches_oracle(CFG, params, drive(eng))
+
+    def test_long_prompt_never_stalls_resident_decode(self):
+        # THE TTFT-bound regression: while a long prompt lands chunk by
+        # chunk, the already-resident slot must emit one token on EVERY
+        # step — chunked prefill interleaves, it does not stall.
+        params = _params(CFG)
+        eng = serve.Engine(
+            CFG, params,
+            serve.ServeConfig(slots=2, block_size=4, prefill_chunk=2))
+        r0 = eng.submit(PROMPTS[0], max_new=10)
+        eng.step()                       # r0 resident, decoding
+        long_p = np.arange(1, 13)        # 12 tokens -> 6 chunks of 2
+        r1 = eng.submit(long_p, max_new=3)
+        stall_free_steps = 0
+        while eng._prefill_jobs:
+            ev = eng.step()
+            assert r0 in ev["emitted"], \
+                "resident decode stalled during chunked prefill"
+            stall_free_steps += 1
+        assert stall_free_steps >= 5     # the job really spanned steps
+        res = eng.run()
+        np.testing.assert_array_equal(
+            res[r0], oracle_tokens(CFG, params, PROMPTS[0], 10))
+        np.testing.assert_array_equal(
+            res[r1], oracle_tokens(CFG, params, long_p, 3))
+
+    def test_unchunked_long_prompt_admission_is_atomic(self):
+        # Control for the test above: without prefill_chunk the same
+        # admission runs the whole prompt in one step (dense
+        # semantics), so the chunked path is what bounds it.
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4))
+        r1 = eng.submit(np.arange(1, 13), max_new=3)
+        ev = eng.step()
+        assert r1 in ev["admitted"]
+        assert not eng._prefill_jobs
+
+
+class TestPagedPoolAccounting:
+    def test_block_level_counters_and_census(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4,
+                                             num_blocks=6))
+        eng.submit(PROMPTS[0], max_new=4)     # 3 tokens -> 1 page
+        eng.step()
+        snap = eng.stats.snapshot()
+        assert snap["blocks_in_use"] == eng._mgr.blocks_in_use > 0
+        assert snap["blocks_in_use"] + snap["blocks_free"] \
+            + snap["blocks_cached"] == 6
+        hd = CFG.d_model // CFG.n_heads
+        row = 2 * CFG.kv_heads * hd * CFG.n_layers \
+            * jnp.dtype(eng._dtype).itemsize
+        assert eng.kv_bytes_resident() \
+            == eng._mgr.blocks_in_use * 4 * row
+        # Dense census for comparison: full max_seq rows per occupied
+        # slot — the paged engine's residency is strictly smaller for
+        # a short sequence.
+        dense = serve.Engine(CFG, params, serve.ServeConfig(slots=2))
+        dense.submit(PROMPTS[0], max_new=4)
+        dense.step()
+        assert dense.kv_bytes_resident() == CFG.max_seq * row
+        assert eng.kv_bytes_resident() < dense.kv_bytes_resident()
+
+    def test_submit_rejects_request_larger_than_pool(self):
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=1, block_size=4,
+                                             num_blocks=2))
+        with pytest.raises(ValueError, match="pages"):
+            eng.submit(np.arange(1, 10), max_new=8)   # needs 4 pages
+
+    def test_config_validation(self):
+        params = _params(CFG)
+        with pytest.raises(ValueError, match="divide"):
+            serve.Engine(CFG, params,
+                         serve.ServeConfig(slots=1, block_size=5))
+        with pytest.raises(ValueError, match="block_size"):
+            serve.ServeConfig(block_size=-1)
+        with pytest.raises(ValueError, match="num_blocks"):
+            serve.ServeConfig(block_size=4, num_blocks=0)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            serve.ServeConfig(prefill_chunk=2)       # needs paging
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            serve.ServeConfig(block_size=4, prefill_chunk=0)
+
+    def test_registry_sync_guard(self):
+        from mpi4torch_tpu.analyze.registry import serve_paging_problems
+
+        assert serve_paging_problems() == []
+
+
+class TestPagedNoRetrace:
+    def test_lowered_step_identical_across_table_states(self):
+        from mpi4torch_tpu._compat import lowered_text
+
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4,
+                                             overlap=True),
+                           spmd=True, nranks=4)
+        eng.submit(PROMPTS[0], max_new=6)
+        eng.step()
+        txt1 = lowered_text(eng.lower_step(), debug_info=False)
+        eng.submit(PROMPTS[1], max_new=4)
+        eng.step()
+        txt2 = lowered_text(eng.lower_step(), debug_info=False)
+        assert txt1 == txt2
+        assert txt1.count('"stablehlo.gather"') >= 2 * CFG.n_layers
+
+
+class TestPagedDrainReadmit:
+    def test_tickets_carry_pages_and_readmit_prefix_hits(self):
+        # Satellite 6: a drained paged request's ticket carries its
+        # block-table state, and re-admission recovers the pages
+        # through the prefix index — prefill re-runs ~1 token, and the
+        # stitched stream stays bitwise the oracle.
+        from mpi4torch_tpu.elastic import replan as E
+
+        params = _params(CFG)
+        eng = serve.Engine(CFG, params,
+                           serve.ServeConfig(slots=2, block_size=4))
+        eng.submit(PROMPTS[0], max_new=6)
+        eng.submit(PROMPTS[1], max_new=4)
+        eng.step(); eng.step()
+        tickets, _ = E.drain_tickets(eng)
+        for t in tickets:
+            assert t.pages is not None
+            assert t.pages["n_tokens"] > 0
+            assert len(t.pages["block_ids"]) \
+                == -(-t.pages["n_tokens"] // 4)
+        serve.reset_stats()
+        E.readmit(eng, tickets)
+        res = eng.run()
+        stitched = E.stitched_results(res, tickets)
+        np.testing.assert_array_equal(
+            stitched[0], oracle_tokens(CFG, params, PROMPTS[0], 6))
+        np.testing.assert_array_equal(
+            stitched[1], oracle_tokens(CFG, params, PROMPTS[1], 4))
+        snap = eng.stats.snapshot()
+        assert snap["prefix_hits"] == 2          # both re-admissions hit
+        # Each readmission prefilled ONLY its uncovered suffix (1-2
+        # tokens past the registered rows), not the whole prompt.
+        assert snap["prefill_tokens"] <= 2 * 2
+
+
+class TestBlockManager:
+    def test_alloc_release_cache_eviction(self):
+        from mpi4torch_tpu.serve import BlockManager
+
+        m = BlockManager(4, 2)
+        a = m.alloc(2)
+        assert m.blocks_in_use == 2 and m.free_blocks == 2
+        # Register then release: pages park CACHED, not freed.
+        m.register(np.array([1, 2, 3]), a, 3)
+        m.release(a)
+        assert m.blocks_in_use == 0 and m.cached_blocks == 2
+        # A full-pool alloc reclaims them LRU (index entries dropped).
+        b = m.alloc(4)
+        assert b is not None and m.cached_blocks == 0
+        assert m.match(np.array([1, 2, 3]), 2) == ([], 0)
+        assert m.alloc(1) is None
+        for x in b:
+            m.release([x])
+        assert m.free_blocks == 4
+
+    def test_match_caps_below_limit_and_checks_content(self):
+        from mpi4torch_tpu.serve import BlockManager
+
+        m = BlockManager(8, 2)
+        toks = np.array([5, 6, 7, 8, 9])
+        ids = m.alloc(3)
+        m.register(toks, ids, 5)
+        # Full chain + partial tail, capped at limit.
+        got_ids, n = m.match(toks, 4)
+        assert n == 4 and got_ids == ids[:2]
+        got_ids, n = m.match(toks, 5)
+        assert n == 5 and got_ids == ids
+        # Diverging content does not match past the divergence.
+        other = np.array([5, 6, 99, 8, 9])
+        got_ids, n = m.match(other, 5)
+        assert n == 2 and got_ids == ids[:1]
+
+    def test_release_unreferenced_raises(self):
+        from mpi4torch_tpu.serve import BlockManager
+
+        m = BlockManager(2, 2)
+        a = m.alloc(1)
+        m.release(a)
+        with pytest.raises(ValueError, match="unreferenced"):
+            m.release(a)
