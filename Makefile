@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke transport-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke tiers-smoke transport-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -193,6 +193,23 @@ ir-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.csched --smoke
+
+# Multi-pod tier-stack lane (ISSUE 18): per nested factorization of
+# the 8-virtual-device world ((2,2,2)/(4,2)/(2,4)/(8,)), the
+# bandwidth-weighted synthesis winner under skewed slow-outer
+# tier_bandwidths must beat the flat bidir baseline on the weighted
+# census with the outer-tier byte reduction confirmed by the per-tier
+# table of the ACTUAL lowering (analyze.tier_wire_table == the IR
+# program's tier census EXACTLY); every searched tier composition
+# holds Mode A/B bitwise parity + a self-adjoint transposition; the
+# 2-level stack lowers text-identical to the historical hier forms;
+# obs.reconcile(..., tiers=) prices the measured Mode B per-tier
+# traffic EXACTLY; and the tier composition registry-sync guard is
+# clean.  Exits non-zero on any divergence.
+tiers-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.csched --tiers
 
 # CPU smoke run of the multi-process transport runtime
 # (mpi4torch_tpu.transport): bitwise thread-vs-process parity on

@@ -2099,6 +2099,68 @@ def _bench_schedule_synthesis(on_tpu: bool):
     }
 
 
+def _bench_allreduce_tiers(on_tpu: bool):
+    """Tier-stack synthesis stanza (ISSUE 18): the bandwidth-weighted
+    census verdict of the multi-pod tier-dimension search.  Per nested
+    factorization of the attached world and size bucket, the weighted
+    winner under a skewed slow-outer bandwidth profile (outer tier 20x
+    under the inner — the DCN-under-ICI shape) is compared against the
+    flat ``bidir`` baseline: the per-tier wire table, the weighted
+    cost, and ``tier_weighted_gain`` (baseline weighted cost over
+    winner's — > 1.0 is a win).  Deterministic census arithmetic, so
+    recorded on any hardware, like the flat synthesis stanza."""
+    import jax
+
+    from mpi4torch_tpu import csched
+
+    ndev = len(jax.devices())
+    stacks = [s for s in ((2, 2, 2), (4, 2), (2, 4))
+              if _prod(s) == ndev] or ([(2, ndev // 2)]
+                                       if ndev >= 4 and ndev % 2 == 0
+                                       else [])
+    sizes = (1 << 10, 1 << 14, 1 << 18)
+    entries = {}
+    any_gain = False
+    for stack in stacks:
+        skew = tuple([1.0] * (len(stack) - 1) + [0.05])
+        per = {}
+        for nbytes in sizes:
+            res = csched.synthesize_tiers(ndev, nbytes, 4, tiers=stack,
+                                          tier_bandwidths=skew)
+            gain = (res["bidir_weighted_cost"]
+                    / max(res["weighted_cost"], 1e-12))
+            any_gain = any_gain or res["beats_bidir"]
+            per[str(nbytes)] = {
+                "winner": res["winner"],
+                "chain": res["chain"],
+                "composition": res["composition"],
+                "tier_wire": res["tier_wire"],
+                "bidir_tier_wire": res["bidir_tier_wire"],
+                "weighted_cost": res["weighted_cost"],
+                "bidir_weighted_cost": res["bidir_weighted_cost"],
+                "tier_weighted_gain": round(gain, 3),
+                "outer_tier_wire_reduction": (
+                    res["bidir_tier_wire"][-1] - res["tier_wire"][-1]),
+                "beats_bidir": res["beats_bidir"],
+            }
+        entries["x".join(map(str, stack))] = per
+    return {
+        "mode": ("deterministic bandwidth-weighted census sweep "
+                 "(slow-outer skew 20:1)"),
+        "nranks": ndev,
+        "stacks": ["x".join(map(str, s)) for s in stacks],
+        "entries": entries,
+        "tier_weighted_gain": any_gain,
+    }
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
 def _bench_transport(on_tpu: bool):
     """Transport-runtime stanza (ISSUE 16): the first HONEST wall-clock
     numbers for Mode B — ``process_parallel_speedup`` is thread-backend
@@ -2257,6 +2319,7 @@ def main() -> None:
         srvp = _guarded("serve_paged", _bench_serve_paged, on_tpu)
         syn = _guarded("schedule_synthesis", _bench_schedule_synthesis,
                        on_tpu)
+        tirs = _guarded("allreduce_tiers", _bench_allreduce_tiers, on_tpu)
         trn = _guarded("transport", _bench_transport, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
@@ -2299,6 +2362,7 @@ def main() -> None:
             "serve": srv,
             "serve_paged": srvp,
             "schedule_synthesis": syn,
+            "allreduce_tiers": tirs,
             "transport": trn,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,

@@ -41,6 +41,7 @@ copies.  ``make analyze-smoke`` runs sweep + defect corpus on the
 """
 
 from .accounting import (peak_live_bytes, scheduled_exposure,
+                         tier_wire_table, weighted_wire_cost,
                          wire_bytes_per_device, wire_contribution)
 from .defects import (DEFECTS, Defect, DefectPrograms,
                       defect_ledger_problems, run_defect_corpus)
@@ -66,6 +67,8 @@ __all__ = [
     "check_vjp_symmetry",
     "wire_bytes_per_device",
     "wire_contribution",
+    "tier_wire_table",
+    "weighted_wire_cost",
     "peak_live_bytes",
     "scheduled_exposure",
     "DEFECTS",

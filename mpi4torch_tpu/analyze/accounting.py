@@ -44,6 +44,8 @@ from .parse import (WIRE_OPS, ParsedProgram, dtype_bytes,
 __all__ = [
     "wire_contribution",
     "wire_bytes_per_device",
+    "tier_wire_table",
+    "weighted_wire_cost",
     "peak_live_bytes",
     "scheduled_exposure",
 ]
@@ -109,6 +111,76 @@ def wire_bytes_per_device(lowered_or_text) -> Tuple[int, Dict[str, int]]:
         wire += wire_contribution(op.kind, _payload_bytes(op),
                                   op.group_size)
     return int(round(wire)), counts
+
+
+def _op_tier(op, tiers) -> int:
+    """Tier of ONE parsed collective under the mixed-radix attribution
+    rule (single source: :func:`mpi4torch_tpu.csched.census.tier_of_group`
+    — the highest tier whose digit differs among any group's members).
+    A ``collective_permute`` is attributed by its ``source_target_pairs``
+    (each pair is a 2-member group); an op with no replica groups spans
+    the whole axis and prices at the top tier."""
+    from ..csched.census import tier_of_group
+
+    top = len(tiers) - 1
+    if op.kind == "collective_permute":
+        pairs = op.source_target_pairs
+        if not pairs:
+            return top
+        return max(tier_of_group(pair, tiers) for pair in pairs)
+    if not op.replica_groups:
+        return top
+    return max(tier_of_group(g, tiers) for g in op.replica_groups)
+
+
+def tier_wire_table(lowered_or_text, tiers) -> List[int]:
+    """Per-tier split of :func:`wire_bytes_per_device` under a flat-world
+    tier stack ``tiers`` (innermost first — the
+    ``config.tier_stack()`` / ``tune.resolve_tier_stack`` grammar).
+
+    Each parsed collective's whole wire contribution lands on the tier
+    of its WIDEST replica-group span (an ``all_gather`` over an
+    innermost-tier group is intra-pod traffic no matter how many such
+    groups tile the axis; a group mixing outer-tier digits crosses the
+    outer wire).  The returned ints sum to the
+    :func:`wire_bytes_per_device` total, so this is a *breakdown*, not
+    a second accounting — the same invariant
+    :func:`mpi4torch_tpu.csched.census.program_tier_census` keeps on the
+    IR side, which lets the ``--tiers`` lane assert the lowered text's
+    table equals the program census exactly."""
+    tiers = tuple(int(g) for g in tiers)
+    if not tiers:
+        raise ValueError("tier_wire_table needs a non-empty tier stack")
+    parsed = _parsed(lowered_or_text)
+    per = [0.0] * len(tiers)
+    for op in parsed.collectives:
+        if op.kind != "collective_permute" and op.group_size is None:
+            continue
+        per[_op_tier(op, tiers)] += wire_contribution(
+            op.kind, _payload_bytes(op), op.group_size)
+    return [int(round(w)) for w in per]
+
+
+def weighted_wire_cost(lowered_or_text, tier_bandwidths,
+                       tiers=None) -> float:
+    """The bandwidth-weighted wire census of a lowered program:
+    ``sum(tier_wire[l] / tier_bandwidths[l])`` — relative seconds-on-wire
+    under the configured per-tier bandwidths, the ranking functional of
+    tier-dimension synthesis (:func:`mpi4torch_tpu.csched.synthesize_tiers`)
+    read off the ACTUAL lowering rather than the IR census.  ``tiers``
+    defaults to ``config.tier_stack()`` (which must then be set)."""
+    from ..csched.census import weighted_cost
+
+    if tiers is None:
+        from .. import config as _config
+
+        tiers = _config.tier_stack()
+        if tiers is None:
+            raise ValueError(
+                "weighted_wire_cost needs a tier stack: pass tiers= or "
+                "set config.set_tier_stack(...)")
+    return weighted_cost(tier_wire_table(lowered_or_text, tiers),
+                         tier_bandwidths)
 
 
 # ----------------------------------------------------------- peak liveness

@@ -37,6 +37,7 @@ __all__ = [
     "tune_problems",
     "overlap_split_phase_problems",
     "csched_problems",
+    "tier_program_problems",
     "transport_problems",
     "standing_problems",
 ]
@@ -363,6 +364,53 @@ def csched_problems() -> List[str]:
     return problems
 
 
+def tier_program_problems() -> List[str]:
+    """Tier-composition registry sync (ISSUE 18): every per-tier
+    (algorithm x codec) composition the tier synthesis searches
+    (``csched.TIER_COMPOSITIONS``) must hold a Mode A/B parity cell AND
+    a per-tier census cell in the ``--tiers`` lane's coverage literals
+    (``csched.__main__.TIER_PARITY_COVERED`` /
+    ``TIER_CENSUS_COVERED``), and must transpose to a program with the
+    forward's census (the declared ``"self"`` VJP every allreduce
+    schedule ships) — so a new composition cannot enter the search
+    space without bitwise and census evidence, structurally."""
+    from .. import csched
+
+    problems = set_drift(
+        csched.TIER_COMPOSITIONS,
+        _tier_lane_literals()[0],
+        "tier compositions {registered} out of sync with the --tiers "
+        "lane's parity matrix {covered} — every searched composition "
+        "needs a Mode A/B bitwise parity cell (TIER_PARITY_COVERED)")
+    problems += set_drift(
+        csched.TIER_COMPOSITIONS,
+        _tier_lane_literals()[1],
+        "tier compositions {registered} out of sync with the --tiers "
+        "lane's census matrix {covered} — every searched composition "
+        "needs a per-tier census cell (TIER_CENSUS_COVERED)")
+    tiers = (2, 2, 2)
+    for comp in csched.TIER_COMPOSITIONS:
+        prog = csched.fold_program(8, tiers, tiers)
+        if comp == "q8-slow":
+            prog = csched.rewrite_fold_codec(prog, (len(tiers) - 1,))
+        fwd = csched.program_tier_census(prog, 1024, 4, tiers)
+        bwd = csched.program_tier_census(csched.transpose(prog), 1024, 4,
+                                         tiers)
+        if fwd != bwd:
+            problems.append(
+                f"tier composition {comp!r} does not transpose to its "
+                f"own per-tier census (fwd {fwd} vs bwd {bwd}) — the "
+                "declared 'self' VJP no longer holds")
+    return problems
+
+
+def _tier_lane_literals():
+    from ..csched.__main__ import (TIER_CENSUS_COVERED,
+                                   TIER_PARITY_COVERED)
+
+    return TIER_PARITY_COVERED, TIER_CENSUS_COVERED
+
+
 # -------------------------------------------------------------- transport
 
 def transport_problems() -> List[str]:
@@ -395,6 +443,7 @@ def standing_problems() -> List[str]:
     problems += [f"degrade: {p}" for p in degrade_problems()]
     problems += [f"reshard: {p}" for p in reshard_step_problems()]
     problems += [f"csched: {p}" for p in csched_problems()]
+    problems += [f"csched: {p}" for p in tier_program_problems()]
     problems += [f"transport: {p}" for p in transport_problems()]
     from ..serve.__main__ import PARITY_POLICIES
     problems += [f"serve: {p}"

@@ -302,7 +302,8 @@ class MPI_Communicator:
             codec = None
         backend = self._backend()
         if getattr(backend, "owns_algorithm_resolution", False):
-            # The 2-axis hier backend keys its tiers off the mesh axes
+            # The tier-stack backend (2-axis hier included) keys its
+            # tiers off the mesh axes
             # themselves, so the registry's flat-world applicability
             # gates (power-of-two, group factorization of the rank
             # PRODUCT) do not apply — validate the name only and let
@@ -325,13 +326,14 @@ class MPI_Communicator:
             algo_explicit=algo_explicit)
         if codec is not None and not getattr(backend,
                                              "supports_compression", True):
-            # Backends without a compressed pipeline (the 2-axis hier
-            # communicator): an explicit codec raises, a scope default
-            # degrades to the exact wire — the standard rule.
+            # Backends without a compressed pipeline (the mesh-axis
+            # tier-stack communicators): an explicit codec raises, a
+            # scope default degrades to the exact wire — the standard
+            # rule.
             if compression is not None:
                 raise ValueError(
                     f"compression={codec.name!r} is not supported on "
-                    "this communicator (the 2-axis hierarchical "
+                    "this communicator (the mesh-axis tier-stack "
                     "backend has no compressed pipeline); use a "
                     "single-axis comm_from_mesh communicator")
             codec = None

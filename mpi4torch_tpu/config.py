@@ -564,6 +564,66 @@ def set_hier_group_size(g) -> None:
     _hier_group_size = g
 
 
+# N-level tier factorization of a single-axis communicator, innermost
+# (fastest interconnect) first — e.g. (4, 2) = groups of 4 inside a pod,
+# 2 pods.  Generalizes _hier_group_size: a 2-level stack (g, n // g) is
+# exactly hier_group_size=g.  None = derive (hier_group_size, else the
+# sqrt-divisor 2-level split).  See mpi4torch_tpu.tune.resolve_tier_stack.
+_tier_stack = None
+# Relative bandwidth of each tier's interconnect, aligned with the tier
+# stack (innermost first) — e.g. (1.0, 0.05) for fast ICI under slow DCN.
+# The weights of the bandwidth-weighted wire census (csched.weighted_cost,
+# analyze.weighted_wire_cost); None = uniform.
+_tier_bandwidths = None
+
+
+def tier_stack():
+    """The configured tier-stack factorization (innermost first), or
+    None to derive.  Each factor must be >= 2 and the product must equal
+    the communicator size (validated where it is resolved)."""
+    return _tier_stack
+
+
+def set_tier_stack(stack) -> None:
+    global _tier_stack
+    if stack is None:
+        _tier_stack = None
+        return
+    try:
+        stack = tuple(int(g) for g in stack)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"tier_stack must be a tuple of ints >= 2 or None, got "
+            f"{stack!r}") from None
+    if not stack or any(g < 2 for g in stack):
+        raise ValueError(
+            f"tier_stack factors must all be >= 2, got {stack!r}")
+    _tier_stack = stack
+
+
+def tier_bandwidths():
+    """Per-tier relative bandwidths (innermost first), or None for
+    uniform weights.  Aligned with the resolved tier stack."""
+    return _tier_bandwidths
+
+
+def set_tier_bandwidths(bws) -> None:
+    global _tier_bandwidths
+    if bws is None:
+        _tier_bandwidths = None
+        return
+    try:
+        bws = tuple(float(b) for b in bws)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"tier_bandwidths must be a tuple of positive numbers or "
+            f"None, got {bws!r}") from None
+    if not bws or any(b <= 0 for b in bws):
+        raise ValueError(
+            f"tier_bandwidths must all be > 0, got {bws!r}")
+    _tier_bandwidths = bws
+
+
 # ---------------------------------------------------------------------------
 # Fault tolerance (mpi4torch_tpu.resilience; ISSUE 7)
 # ---------------------------------------------------------------------------
@@ -769,6 +829,8 @@ def snapshot_process_state() -> dict:
         "bandwidth_crossover_bytes": _bandwidth_crossover_bytes,
         "phase_pipelined_ring": _phase_pipelined_ring,
         "hier_group_size": _hier_group_size,
+        "tier_stack": _tier_stack,
+        "tier_bandwidths": _tier_bandwidths,
         "chain_unroll_max": _chain_unroll_max,
         "quant_hop_impl": _quant_hop_impl,
         "serve_decode_buckets": _serve_decode_buckets,
@@ -795,6 +857,8 @@ def apply_process_state(state: dict) -> None:
     set_bandwidth_crossover_bytes(state["bandwidth_crossover_bytes"])
     set_phase_pipelined_ring(state["phase_pipelined_ring"])
     set_hier_group_size(state["hier_group_size"])
+    set_tier_stack(state["tier_stack"])
+    set_tier_bandwidths(state["tier_bandwidths"])
     set_chain_unroll_max(state["chain_unroll_max"])
     set_quant_hop_impl(state["quant_hop_impl"])
     set_serve_decode_buckets(state["serve_decode_buckets"])
@@ -852,7 +916,8 @@ def thresholds_fingerprint():
     return (_ordered_fold_gather_max_bytes, _ordered_ring_chunk_bytes,
             _bcast_tree_max_bytes, _latency_crossover_bytes,
             _bandwidth_crossover_bytes, _phase_pipelined_ring,
-            _hier_group_size, _chain_unroll_max, _quant_hop_impl,
+            _hier_group_size, _tier_stack, _tier_bandwidths,
+            _chain_unroll_max, _quant_hop_impl,
             _comm_finite_guard, _reshard_strategy,
             _serve_decode_buckets,
             bool(_comm_tracer is not None

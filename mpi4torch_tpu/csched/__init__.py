@@ -20,7 +20,9 @@ synthesized-schedule census verdict.
 
 from __future__ import annotations
 
-from .census import census_covers, program_census
+from .census import (census_covers, program_census,
+                     program_tier_census, tier_of_group,
+                     tier_of_groups, weighted_cost)
 from .interp import interpret_allreduce, interpreter_covers, \
     level_fold_groups
 from .ir import (Phase, Program, STEP_KINDS, Step, transpose,
@@ -31,9 +33,11 @@ from .programs import (NATIVE_EXEMPT, PROGRAM_ALGORITHMS,
                        allreduce_program, bcast_program, has_program,
                        q8_allreduce_program, reduce_program,
                        rewrite_codec)
-from .synth import (autotune_synthesis, factorization_chains,
+from .synth import (TIER_COMPOSITIONS, autotune_synthesis,
+                    autotune_tier_synthesis, factorization_chains,
                     fold_program, install, installed_program,
-                    is_synth_name, synth_applicable, synthesize)
+                    is_synth_name, rewrite_fold_codec,
+                    synth_applicable, synthesize, synthesize_tiers)
 
 __all__ = [
     "Program", "Phase", "Step", "STEP_KINDS", "transpose",
@@ -42,10 +46,13 @@ __all__ = [
     "PROGRAM_ALGORITHMS", "NATIVE_EXEMPT",
     "lower_allreduce", "lower_value", "lower_q8_allreduce",
     "interpret_allreduce", "level_fold_groups",
-    "program_census",
+    "program_census", "program_tier_census", "tier_of_group",
+    "tier_of_groups", "weighted_cost",
     "synthesize", "fold_program", "factorization_chains",
     "autotune_synthesis", "install", "installed_program",
     "is_synth_name", "synth_applicable",
+    "synthesize_tiers", "autotune_tier_synthesis",
+    "rewrite_fold_codec", "TIER_COMPOSITIONS",
     "lowering_covers", "interpreter_covers", "transposition_covers",
     "census_covers",
     "declared_vjp_census",

@@ -18,6 +18,19 @@ Non-zero exit on ANY divergence.  Three legs (``make ir-smoke``):
    world beats the hand-written deterministic ring on wire bytes, its
    predicted HLO census matches ``analyze.parse_program`` of the
    actual lowering EXACTLY, and the search is deterministic.
+
+``python -m mpi4torch_tpu.csched --tiers`` (``make tiers-smoke``) is
+the multi-pod tier-stack lane (ISSUE 18): per nested factorization of
+the 8-device world — (2,2,2), (4,2), (2,4), (8,) — the
+bandwidth-weighted synthesis winner under skewed slow-outer
+``tier_bandwidths`` beats the flat ``bidir`` baseline with the
+outer-tier byte reduction confirmed by the per-tier census of the
+ACTUAL lowering (``analyze.tier_wire_table``), every searched
+composition (``TIER_PARITY_COVERED``/``TIER_CENSUS_COVERED``) holds
+Mode A/B bitwise parity and a self-adjoint transposition, the 2-level
+stack lowers text-identical to the historical hier forms, and
+``obs.reconcile(..., tiers=)`` prices the measured Mode B per-tier
+traffic EXACTLY.
 """
 
 from __future__ import annotations
@@ -25,6 +38,21 @@ from __future__ import annotations
 import json
 import sys
 from typing import Iterable, List
+
+# Coverage literals of the ``--tiers`` lane (``make tiers-smoke``):
+# which per-tier (algorithm x codec) compositions of the tier synthesis
+# search space hold a Mode A/B bitwise parity cell and a per-tier
+# census cell below.  ``analyze.registry.tier_program_problems``
+# compares these against ``csched.TIER_COMPOSITIONS`` — a composition
+# added to the search without lane coverage fails ``make tiers-smoke``
+# AND ``make analyze-smoke`` structurally.
+TIER_PARITY_COVERED = ("exact", "q8-slow")
+TIER_CENSUS_COVERED = ("exact", "q8-slow")
+
+# The nested factorizations the lane exercises on the 8-virtual-device
+# world ((8,) is the degenerate single-tier stack — everything is top
+# tier and the weighted census reduces to the flat one).
+TIER_STACKS = ((2, 2, 2), (4, 2), (2, 4), (8,))
 
 
 def _lower_text(fn, n: int, x, det: bool) -> str:
@@ -222,10 +250,240 @@ def _run_smoke() -> int:
     return 0 if not failures else 1
 
 
+def _mode_a_rows(name: str, n: int, vals, det: bool = True):
+    """Execute an installed program Mode A over an ``n``-device mesh
+    with per-rank values ``vals``; returns the per-rank result rows."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from .. import config as _config
+    from .. import constants as C
+    from .._compat import shard_map
+    from ..ops.spmd import SpmdContext
+    from ..ops import spmd as _spmd
+
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("w",))
+    ctx = SpmdContext(axis_name="w", size=n)
+    stacked = jnp.stack(vals)
+    wrapped = shard_map(
+        lambda v: _spmd._allreduce_fwd_value(ctx, v[0], C.MPI_SUM,
+                                             name)[None],
+        mesh=mesh, in_specs=P("w"), out_specs=P("w"), check_vma=False)
+    with _config.deterministic_mode(det):
+        return jax.jit(wrapped)(stacked)
+
+
+def _run_tiers() -> int:
+    """``--tiers`` (``make tiers-smoke``): the multi-pod tier-stack
+    verdict lane.  Non-zero exit on ANY divergence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import config as _config
+    from .. import constants as C
+    from .. import csched
+    from .. import analyze
+    from ..analyze.registry import tier_program_problems
+    from ..ops import spmd as _spmd
+
+    failures: List[str] = []
+    report = {"nranks": 8, "stacks": [list(s) for s in TIER_STACKS],
+              "synthesis": {}, "parity": {}, "census": {},
+              "two_level": {}, "reconcile": {}}
+
+    def check(ok: bool, label: str):
+        if not ok:
+            failures.append(label)
+        return bool(ok)
+
+    n = 8
+    x = jnp.arange(1024, dtype=jnp.float32)
+    nbytes = x.size * 4
+    # Integer-valued per-rank payloads: po2-scale block-q8 round-trips
+    # integer grids exactly, so the q8-slow composition's Mode A/B
+    # bitwise check is meaningful rather than comparing two rounding
+    # paths.
+    rng = np.random.default_rng(18)
+    vals = [jnp.asarray(rng.integers(-40, 40, 257), jnp.float32)
+            for _ in range(n)]
+
+    # ---- leg 1: registry guard -------------------------------------
+    problems = tier_program_problems()
+    check(not problems, f"tier registry guard: {problems}")
+    report["registry_problems"] = problems
+
+    # ---- leg 2: weighted-census synthesis verdict -------------------
+    # Skewed slow-outer bandwidths: the outermost tier (DCN) 20x under
+    # the inner tiers (ICI) — the multi-pod shape the weighted census
+    # exists for.
+    for stack in TIER_STACKS:
+        skew = tuple([1.0] * (len(stack) - 1) + [0.05]) \
+            if len(stack) > 1 else (1.0,)
+        res = csched.synthesize_tiers(n, nbytes, 4, tiers=stack,
+                                      tier_bandwidths=skew)
+        res2 = csched.synthesize_tiers(n, nbytes, 4, tiers=stack,
+                                       tier_bandwidths=skew)
+        key = "x".join(map(str, stack))
+        cell = {
+            "winner": res["winner"], "chain": res["chain"],
+            "composition": res["composition"],
+            "tier_wire": res["tier_wire"],
+            "weighted_cost": res["weighted_cost"],
+            "bidir_tier_wire": res["bidir_tier_wire"],
+            "bidir_weighted_cost": res["bidir_weighted_cost"],
+            "beats_bidir": res["beats_bidir"],
+            "exact_beats_bidir": res["exact_beats_bidir"],
+        }
+        cell["deterministic"] = check(
+            res["winner"] == res2["winner"],
+            f"tiers {key}: synthesis determinism")
+        if len(stack) > 1:
+            cell["beats_bidir"] = check(
+                res["beats_bidir"],
+                f"tiers {key}: synthesized winner beats flat bidir on "
+                "the weighted census")
+            cell["outer_tier_reduced"] = check(
+                res["tier_wire"][-1] < res["bidir_tier_wire"][-1],
+                f"tiers {key}: outer-tier bytes reduced vs bidir "
+                f"({res['tier_wire'][-1]} vs "
+                f"{res['bidir_tier_wire'][-1]})")
+            # Uniform bandwidths: the lossy variants must vanish (no
+            # regression by construction) and the ranking degenerate to
+            # the unweighted census.
+            uni = csched.synthesize_tiers(n, nbytes, 4, tiers=stack)
+            cell["uniform_all_exact"] = check(
+                all(c["composition"] == "exact"
+                    for c in uni["candidates"]),
+                f"tiers {key}: uniform bandwidths admit lossy variants")
+        report["synthesis"][key] = cell
+
+        # ---- leg 3: per-tier census of the ACTUAL lowering ----------
+        for label, prog in (("winner", res["program"]),
+                            ("exact", res["exact_program"])):
+            name = csched.install(prog)
+            txt = _lower_text(
+                lambda c, v: _spmd._allreduce_fwd_value(
+                    c, v, C.MPI_SUM, name), n, x, True)
+            got = analyze.tier_wire_table(txt, stack)
+            pred = csched.program_tier_census(prog, x.size, 4, stack)
+            report["census"][f"{key}/{label}"] = check(
+                got == pred,
+                f"tiers {key}/{label}: analyze tier table {got} != "
+                f"program tier census {pred}")
+            wc = analyze.weighted_wire_cost(txt, skew, tiers=stack)
+            report["census"][f"{key}/{label}/weighted"] = check(
+                wc == csched.weighted_cost(pred, skew),
+                f"tiers {key}/{label}: weighted_wire_cost mismatch")
+
+    # ---- leg 4: Mode A/B bitwise parity per composition -------------
+    stack = (2, 2, 2)
+    for comp in TIER_PARITY_COVERED:
+        prog = csched.fold_program(n, stack, stack)
+        if comp == "q8-slow":
+            prog = csched.rewrite_fold_codec(prog, (len(stack) - 1,))
+        name = csched.install(prog)
+        rows = _mode_a_rows(name, n, vals)
+        oracle = csched.interpret_allreduce(prog, C.MPI_SUM, vals)
+        cell = {}
+        cell["a_vs_b_bitwise"] = check(
+            bool(jnp.all(rows[0] == oracle)),
+            f"tiers parity {comp}: Mode A != Mode B bitwise")
+        cell["ranks_agree"] = check(
+            all(bool(jnp.all(rows[r] == rows[0])) for r in range(n)),
+            f"tiers parity {comp}: ranks disagree")
+        # The ONE transposition rule still derives the backward: the
+        # transposed program lowers and censuses as the forward does
+        # (allreduce(SUM) is self-adjoint).
+        bwd = csched.transpose(prog)
+        cell["vjp_self"] = check(
+            csched.program_tier_census(bwd, x.size, 4, stack)
+            == csched.program_tier_census(prog, x.size, 4, stack),
+            f"tiers parity {comp}: transposed tier census differs")
+        report["parity"][comp] = cell
+
+    # ---- leg 5: 2-level tier stack == hier, text-identical ----------
+    # (a) flat world: config.tier_stack=(2,4) must lower the 'hier'
+    # schedule byte-identically to the pre-tier hier_group_size form.
+    t_base = _lower_text(
+        lambda c, v: _spmd._allreduce_fwd_value(c, v, C.MPI_SUM,
+                                                "hier"), n, x, True)
+    _config.set_tier_stack((2, 4))
+    try:
+        t_tiered = _lower_text(
+            lambda c, v: _spmd._allreduce_fwd_value(c, v, C.MPI_SUM,
+                                                    "hier"), n, x, True)
+    finally:
+        _config.set_tier_stack(None)
+    report["two_level"]["flat_hier_text"] = check(
+        t_base == t_tiered,
+        "2-level tier_stack changes the flat hier lowering")
+    # (b) mesh world: the 2-axis TierStackBackend vs HierMeshBackend.
+    from jax.sharding import Mesh, PartitionSpec as P
+    from .._compat import shard_map
+    from ..ops.spmd import HierMeshBackend, TierStackBackend
+
+    mesh2 = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                 ("g", "l"))
+
+    def lower_backend(back):
+        wrapped = shard_map(lambda v: back.allreduce(v, C.MPI_SUM),
+                            mesh=mesh2, in_specs=P(), out_specs=P(),
+                            check_vma=False)
+        return jax.jit(wrapped).lower(x).as_text()
+
+    report["two_level"]["mesh_text"] = check(
+        lower_backend(TierStackBackend(("g", "l"), (2, 4)))
+        == lower_backend(HierMeshBackend(("g", "l"), (2, 4))),
+        "2-axis TierStackBackend lowers differently from "
+        "HierMeshBackend")
+
+    # ---- leg 6: obs.reconcile prices per-tier traffic EXACTLY -------
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu import obs
+
+    stack = (2, 2, 2)
+    res = csched.synthesize_tiers(n, nbytes, 4, tiers=stack,
+                                  tier_bandwidths=(1.0, 1.0, 0.05))
+    name = csched.install(res["program"])
+    comm = mpi.COMM_WORLD
+
+    with obs.trace() as t:
+        def body(rank):
+            return comm.Allreduce(x * (rank + 1), mpi.MPI_SUM,
+                                  algorithm=name)
+        mpi.run_ranks(body, n)
+    lowered = _lower_text(
+        lambda c, v: _spmd._allreduce_fwd_value(c, v, C.MPI_SUM, name),
+        n, x, True)
+    rep = obs.reconcile(t.events, lowered, dropped=t.dropped,
+                        tiers=stack)
+    report["reconcile"] = {
+        "measured_tier_wire": rep["measured"].get("tier_wire"),
+        "predicted_tier_wire": rep["predicted"].get("tier_wire"),
+        "matches": rep["matches"],
+        "ok": rep["ok"],
+    }
+    check(rep["ok"] and rep["matches"].get("tier_wire"),
+          f"reconcile per-tier mismatch: measured "
+          f"{rep['measured'].get('tier_wire')} vs predicted "
+          f"{rep['predicted'].get('tier_wire')} "
+          f"(matches={rep['matches']})")
+
+    report["failures"] = failures
+    report["ok"] = not failures
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 0 if not failures else 1
+
+
 def _main(argv: Iterable[str]) -> int:
     argv = list(argv)
     if "--smoke" in argv:
         return _run_smoke()
+    if "--tiers" in argv:
+        return _run_tiers()
     print(__doc__)
     return 0
 

@@ -128,6 +128,20 @@ def _census_q8_ring_channel(step, n, elems, itemsize):
     return wire_round * rounds, 2 * (n - 1) * rounds, hlo
 
 
+def _census_q8_level_fold(step, n, elems, itemsize):
+    from ..compress import get_codec
+
+    groups, g = step.params
+    block = get_codec(step.codec or "q8").base().block
+    nb = -(-max(elems, 1) // block)
+    # One grouped gather of the encoded contribution: (g-1) members,
+    # each a zero-padded int8 payload (lower.q8_fold_blocks — the
+    # shared padding rule) plus one f32 scale per block, gathered as
+    # two all-gathers (payload, scales).
+    wire = (g - 1) * (nb * block + 4 * nb)
+    return float(wire), 1, {"all_gather": 2}
+
+
 CENSUS = {
     "native_allreduce": _census_native_allreduce,
     "level_fold": _census_level_fold,
@@ -139,6 +153,7 @@ CENSUS = {
     "ring_chain": _census_ring_chain,
     "grouped_sum": _census_grouped_sum,
     "q8_ring_channel": _census_q8_ring_channel,
+    "q8_level_fold": _census_q8_level_fold,
 }
 
 
@@ -183,3 +198,100 @@ def program_census(program: Program, nelems: int, itemsize: int) -> Dict:
             seq += max(chan_steps.values())
     return {"wire_bytes_per_rank": int(round(wire)), "seq_steps": seq,
             "hlo": hlo, "nsteps": program.nsteps}
+
+
+# ---------------------------------------------------------------------------
+# Tier attribution + the bandwidth-weighted census (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def _tier_digits(rank: int, tiers):
+    """Mixed-radix decomposition of a rank over the tier stack
+    (innermost radix first) — rank = sum(digit[l] * stride[l]) with
+    stride[l] = prod(tiers[:l]), the row-major layout
+    :func:`.synth.chain_groups` and ``TierStackBackend`` both use."""
+    out = []
+    q = int(rank)
+    for radix in tiers:
+        out.append(q % radix)
+        q //= radix
+    return out
+
+
+def tier_of_group(group, tiers) -> int:
+    """THE tier-attribution rule: a replica group's traffic belongs to
+    the HIGHEST tier whose mixed-radix digit differs between any two
+    members — bytes between ranks in different pods cross the inter-pod
+    link no matter how fast the intra-pod hops are.  Shared verbatim by
+    the program census here, the StableHLO census
+    (:func:`mpi4torch_tpu.analyze.tier_wire_table`) and the obs
+    reconciliation, so prediction and measurement can only disagree
+    about traffic, never about pricing."""
+    ds = [_tier_digits(r, tiers) for r in group]
+    for pos in range(len(tiers) - 1, -1, -1):
+        if any(d[pos] != ds[0][pos] for d in ds):
+            return pos
+    return 0
+
+
+def tier_of_groups(groups, tiers) -> int:
+    """Attribution of a grouped step: None (whole axis) is the top
+    tier; an explicit table takes the max over its groups."""
+    if groups is None:
+        return len(tiers) - 1
+    return max(tier_of_group(g, tiers) for g in groups)
+
+
+def program_tier_census(program: Program, nelems: int, itemsize: int,
+                        tiers):
+    """Per-tier wire bytes of a program (innermost tier first; sums to
+    ``program_census(...)['wire_bytes_per_rank']``).  Grouped steps
+    attribute by their group tables; ``grouped_sum`` splits its RS / AR
+    / AG legs by each leg's table; whole-axis schedules (native, ring,
+    butterfly, trees, chains) span every tier and are charged to the
+    slowest link they cross — the top tier."""
+    tiers = tuple(int(t) for t in tiers)
+    per = [0.0] * len(tiers)
+    top = len(tiers) - 1
+    if program is None:
+        return [0] * len(tiers)
+    for phase in program.phases:
+        for step in phase.steps:
+            elems = _span_elems(step, nelems)
+            if elems == 0:
+                continue
+            n = program.nranks
+            if step.kind in ("level_fold", "q8_level_fold"):
+                groups, _g = step.params
+                w, _, _ = CENSUS[step.kind](step, n, elems, itemsize)
+                per[tier_of_groups(groups, tiers)] += w
+            elif step.kind == "grouped_sum":
+                g, rs, ar, ag = step.params
+                s = elems * itemsize
+                ng = n // g
+                per[tier_of_groups(rs, tiers)] += s * (g - 1) / g
+                if ng > 1:
+                    per[tier_of_groups(ar, tiers)] += \
+                        2.0 * (s / g) * (ng - 1) / ng
+                per[tier_of_groups(ag, tiers)] += s * (g - 1) / g
+            else:
+                w, _, _ = CENSUS[step.kind](step, n, elems, itemsize)
+                per[top] += w
+    return [int(round(w)) for w in per]
+
+
+def weighted_cost(per_tier, bandwidths=None) -> float:
+    """The bandwidth-weighted wire cost: ``sum(bytes[l] /
+    bandwidth[l])`` — relative seconds-on-the-wire under the configured
+    per-tier bandwidths (None = uniform).  THE synthesis ranking key
+    and the figure :func:`mpi4torch_tpu.analyze.weighted_wire_cost`
+    computes from lowered text."""
+    per_tier = tuple(per_tier)
+    if bandwidths is None:
+        bandwidths = (1.0,) * len(per_tier)
+    bandwidths = tuple(float(b) for b in bandwidths)
+    if len(bandwidths) != len(per_tier):
+        raise CommError(
+            f"tier_bandwidths has {len(bandwidths)} entries for a "
+            f"{len(per_tier)}-tier stack")
+    return float(sum(w / b for w, b in zip(per_tier, bandwidths)))

@@ -150,6 +150,24 @@ def _interp_q8_ring_channel(step: Step, op: int, vals, codec=None):
     return out
 
 
+def _interp_q8_level_fold(step: Step, op: int, vals):
+    """The ``q8_level_fold`` oracle: every member's contribution
+    crosses the wire encoded and is decoded on arrival
+    (:func:`.lower.q8_fold_roundtrip` — the identical op sequence the
+    Mode A emitter applies to each gathered member), then each group
+    folds the decoded values ascending exactly like ``level_fold`` —
+    bitwise Mode A/B parity by shared implementation, the same
+    discipline as ``q8_ring_channel``."""
+    from .lower import _fold_block, q8_fold_roundtrip
+
+    groups, g = step.params
+    block = _fold_block(step)
+    dec = [q8_fold_roundtrip(jnp.asarray(v), block) for v in vals]
+    if groups is None:
+        return _interp_ordered(step, op, dec)
+    return level_fold_groups(groups, op, dec)
+
+
 INTERP = {
     "native_allreduce": _interp_ordered,
     "level_fold": _interp_level_fold,
@@ -161,6 +179,7 @@ INTERP = {
     "ring_chain": _interp_ordered,
     "grouped_sum": _interp_grouped_sum,
     "q8_ring_channel": _interp_q8_ring_channel,
+    "q8_level_fold": _interp_q8_level_fold,
 }
 
 

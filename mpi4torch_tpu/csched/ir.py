@@ -42,11 +42,23 @@ kind                 meaning (params)
 ``q8_ring_channel``  a codec-rewritten in-schedule quantized ring
                      channel (compress/spmd ``_fused_channel``)
                      ``(sigma_spec, direction, channel, reversible)``
+``q8_level_fold``    a codec-compressed level fold: block-q8 encode →
+                     grouped all-gather of (int8, scales) → decode →
+                     ascending fold.  Deterministic and Mode A/B
+                     bitwise like ``level_fold``; the wire is ~1.125
+                     bytes/elem instead of 4 ``(groups|None,
+                     fold_count)`` (codec rides ``Step.codec``)
 =================== ==================================================
 
 ``Step.span`` places a step on the whole payload (``"all"``) or on a
 multipath half (``("half", k)`` — split at
 :func:`constants.multipath_split`, the shared Mode A/B rule).
+``Step.tier`` is the tier index of a tier-stack composition (0 =
+innermost/fastest interconnect; None = untiered) — annotation only for
+lowering/interp (the groups already encode the placement), but the
+per-tier census (:func:`.census.program_tier_census`) and the
+bandwidth-weighted ranking key off the replica-group structure, so the
+index is a label the weighted census can cross-check.
 ``Step.codec`` is the per-step codec-hop annotation: the codec rewrite
 (:func:`.programs.rewrite_codec`) replaces exact channel steps with
 ``q8_ring_channel`` steps carrying it, so compression is a program
@@ -86,6 +98,7 @@ STEP_KINDS = (
     "ring_chain",
     "grouped_sum",
     "q8_ring_channel",
+    "q8_level_fold",
 )
 
 # Phase kinds: "seq" runs its steps in order on the whole payload;
@@ -114,6 +127,7 @@ class Step:
     params: Tuple = ()
     span: object = "all"          # "all" | ("half", k)
     codec: Optional[str] = None
+    tier: Optional[int] = None    # tier-stack index (0 = innermost)
 
     def __post_init__(self):
         if self.kind not in STEP_KINDS:
@@ -173,8 +187,13 @@ class Program:
             "phases": [
                 {"kind": ph.kind,
                  "steps": [
-                     {"kind": s.kind, "params": s.params,
-                      "span": s.span, "codec": s.codec}
+                     # "tier" only when set: untiered programs keep
+                     # their pre-tier digests (synth:<digest> cache
+                     # identities survive the tier dimension).
+                     dict({"kind": s.kind, "params": s.params,
+                           "span": s.span, "codec": s.codec},
+                          **({"tier": s.tier}
+                             if s.tier is not None else {}))
                      for s in ph.steps]}
                 for ph in self.phases],
         }
@@ -184,7 +203,8 @@ class Program:
         phases = tuple(
             Phase(ph["kind"], tuple(
                 Step(s["kind"], _freeze(s.get("params", ())),
-                     _freeze(s.get("span", "all")), s.get("codec"))
+                     _freeze(s.get("span", "all")), s.get("codec"),
+                     s.get("tier"))
                 for s in ph["steps"]))
             for ph in data["phases"])
         return cls(collective=data["collective"],
@@ -218,6 +238,7 @@ TRANSPOSE_KINDS = {
     "ring_chain": "ring_chain",        # + direction flip (below)
     "grouped_sum": "grouped_sum",      # RS↔AG reversal fixed point
     "q8_ring_channel": "q8_ring_channel",  # + flip when reversible
+    "q8_level_fold": "q8_level_fold",  # gather+fold: direction-free
 }
 
 
@@ -228,12 +249,13 @@ def _flip_step(step: Step) -> Step:
     direction-free."""
     if step.kind == "ring_chain":
         (d,) = step.params
-        return Step("ring_chain", (-d,), step.span, step.codec)
+        return Step("ring_chain", (-d,), step.span, step.codec,
+                    step.tier)
     if step.kind == "q8_ring_channel":
         sigma, d, k, reversible = step.params
         if reversible:
             return Step("q8_ring_channel", (sigma, -d, k, reversible),
-                        step.span, step.codec)
+                        step.span, step.codec, step.tier)
     return step
 
 
@@ -258,7 +280,8 @@ def transpose(program: Optional[Program]) -> Optional[Program]:
                        program.nranks, phases, program.codec)
     phases = tuple(
         Phase(ph.kind, tuple(
-            Step(TRANSPOSE_KINDS[s.kind], s.params, s.span, s.codec)
+            Step(TRANSPOSE_KINDS[s.kind], s.params, s.span, s.codec,
+                 s.tier)
             for s in reversed(ph.steps)))
         for ph in reversed(program.phases))
     collective = {"bcast": "reduce", "reduce": "bcast"}.get(

@@ -119,6 +119,62 @@ def _emit_q8_ring_channel(step: Step, ctx, x, op: int):
         "codec-rewritten pipeline), not the exact emitter")
 
 
+def q8_fold_blocks(flat, block: int):
+    """The (nblocks, block) zero-padded block view of a flat f32
+    payload — the ``q8_level_fold`` wire layout.  ONE padding rule for
+    the Mode A emitter, the Mode B interpreter and the census (which
+    prices the padded int8 payload + 4 bytes/block of scales), so the
+    three can never disagree about bytes on the wire."""
+    nb = -(-max(flat.size, 1) // block)
+    pad = nb * block - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat.reshape(nb, block)
+
+
+def q8_fold_roundtrip(x, block: int):
+    """decode(encode(x)) through the ``q8_level_fold`` wire codec: what
+    a peer's contribution looks like after the grouped gather.  Shared
+    by the Mode A emitter (applied to each gathered member) and the
+    Mode B interpreter (applied rank-locally before the fold) — the
+    same ``quant_kernels.requant_blocks`` op sequence (power-of-two
+    scales, exact dequantize products), so both modes fold
+    bit-identical values."""
+    from ..ops import quant_kernels as _qk
+
+    shape, dtype = jnp.shape(x), jnp.asarray(x).dtype
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    q, scale = _qk.requant_blocks(q8_fold_blocks(flat, block))
+    dec = q.astype(jnp.float32) * scale[:, None]
+    return dec.reshape(-1)[:flat.size].reshape(shape).astype(dtype)
+
+
+def _fold_block(step: Step) -> int:
+    from ..compress import get_codec
+
+    return get_codec(step.codec or "q8").base().block
+
+
+def _emit_q8_level_fold(step: Step, ctx, x, op: int):
+    from ..ops import quant_kernels as _qk
+
+    groups, g = step.params
+    block = _fold_block(step)
+    shape, dtype = x.shape, x.dtype
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    q, scale = _qk.requant_blocks(q8_fold_blocks(flat, block))
+    gather = dict(axis=0, tiled=False,
+                  axis_index_groups=_groups_arg(groups))
+    qs = lax.all_gather(q, ctx.axis_name, **gather)
+    ss = lax.all_gather(scale, ctx.axis_name, **gather)
+    out = None
+    for i in range(g):
+        dec = (qs[i].astype(jnp.float32) * ss[i][:, None]
+               ).reshape(-1)[:flat.size].reshape(shape).astype(dtype)
+        out = dec if out is None else C.combine2(op, out, dec)
+    return out
+
+
 EMIT = {
     "native_allreduce": _emit_native_allreduce,
     "level_fold": _emit_level_fold,
@@ -130,6 +186,7 @@ EMIT = {
     "ring_chain": _emit_ring_chain,
     "grouped_sum": _emit_grouped_sum,
     "q8_ring_channel": _emit_q8_ring_channel,
+    "q8_level_fold": _emit_q8_level_fold,
 }
 
 

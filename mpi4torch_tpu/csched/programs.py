@@ -97,13 +97,30 @@ def allreduce_program(algorithm, n: int, op: int, *, deterministic: bool,
     if algorithm == "hier":
         if n == 1:
             return _ident("allreduce", "hier", n)
-        from ..tune import resolve_hier_group
+        from ..tune import resolve_hier_group, resolve_tier_stack
 
         g = resolve_hier_group(n)
         inner, outer, ngroups = _hier_groups(n, g)
         if op == C.MPI_SUM and not deterministic:
+            # A deeper config.tier_stack merges its outer tiers into
+            # the inter-group stage here: grouped_sum IS the native
+            # 2-level triple (the full N-level recursion lives on the
+            # mesh-axis backend, ops/spmd._tier_sum_schedule).
             return Program("allreduce", "hier", n, (Phase("seq", (
                 Step("grouped_sum", (g, inner, outer, inner)),)),))
+        stack = resolve_tier_stack(n)
+        if len(stack) > 2:
+            # Deterministic N-level stack: the full tier-annotated
+            # grouped-fold chain (one level_fold per configured tier) —
+            # the flat-axis twin of ops/spmd._tier_ordered_fold.
+            from .synth import chain_groups
+
+            steps = tuple(
+                Step("level_fold", (grp, f), tier=level)
+                for level, (grp, f)
+                in enumerate(chain_groups(n, stack)))
+            return Program("allreduce", "hier", n,
+                           (Phase("seq", steps),))
         return Program("allreduce", "hier", n, (Phase("seq", (
             Step("level_fold", (inner, g)),
             Step("level_fold", (outer, ngroups)))),))

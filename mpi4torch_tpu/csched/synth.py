@@ -26,7 +26,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..runtime import CommError
-from .census import program_census
+from .census import (program_census, program_tier_census,
+                     tier_of_groups, weighted_cost)
 from .ir import Phase, Program, Step
 
 # In-process registry of installed synthesized programs, keyed by the
@@ -90,14 +91,23 @@ def chain_groups(n: int, chain: Tuple[int, ...]):
     return levels
 
 
-def fold_program(n: int, chain: Tuple[int, ...]) -> Program:
-    """The multi-level grouped ordered-fold program of a chain."""
+def fold_program(n: int, chain: Tuple[int, ...],
+                 tiers=None) -> Program:
+    """The multi-level grouped ordered-fold program of a chain.  Each
+    step carries its tier index: the chain position by default, or —
+    when the PHYSICAL tier stack ``tiers`` is given — the stack tier
+    its groups attribute to (:func:`.census.tier_of_groups`), so a
+    chain that merges or splits physical tiers is labeled by the links
+    its bytes actually cross."""
     if any(f < 2 for f in chain) or _prod(chain) != n:
         raise CommError(
             f"factorization chain {chain} does not factor a {n}-rank "
             "world into tiers of >= 2")
-    steps = tuple(Step("level_fold", (groups, f))
-                  for groups, f in chain_groups(n, chain))
+    steps = tuple(
+        Step("level_fold", (groups, f),
+             tier=(tier_of_groups(groups, tiers)
+                   if tiers is not None else level))
+        for level, (groups, f) in enumerate(chain_groups(n, chain)))
     return Program("allreduce", "synth", n, (Phase("seq", steps),))
 
 
@@ -158,6 +168,153 @@ def synthesize(n: int, nbytes: int, itemsize: int = 4) -> Dict:
                 c["wire_bytes_per_rank"], "seq_steps": c["seq_steps"]}
             for ch, _p, c in ranked],
     }
+
+
+# ---------------------------------------------------------------------------
+# Tier-dimension synthesis (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+# The registered tier compositions — the per-tier (algorithm × codec)
+# points the tier search emits.  "exact" is a tier-annotated grouped
+# fold chain (every tier exact); "q8-slow" rewrites the chain's
+# slow-tier folds (bandwidth strictly below the stack's fastest) to
+# q8_level_fold codec hops — EQuARX's move of spending quantization
+# where the link is slow.  The registry guard
+# (analyze.registry.tier_program_problems) requires each name to hold a
+# parity cell + census cell in the tiers lane and a declared VJP.
+TIER_COMPOSITIONS = ("exact", "q8-slow")
+
+
+def rewrite_fold_codec(program: Program, slow_tiers,
+                       codec: str = "q8") -> Program:
+    """Per-tier codec rewrite: every ``level_fold`` whose tier index is
+    in ``slow_tiers`` becomes a ``q8_level_fold`` carrying ``codec`` —
+    the same program-transformation discipline as
+    :func:`.programs.rewrite_codec`, applied per tier instead of per
+    channel."""
+    slow = frozenset(slow_tiers)
+    phases = tuple(
+        Phase(ph.kind, tuple(
+            Step("q8_level_fold", s.params, s.span, codec, s.tier)
+            if s.kind == "level_fold" and s.tier in slow else s
+            for s in ph.steps))
+        for ph in program.phases)
+    return Program(program.collective, program.algorithm,
+                   program.nranks, phases, program.codec)
+
+
+def _resolved_tiers(n: int, tiers):
+    from .. import config as _config
+
+    if tiers is None:
+        tiers = _config.tier_stack()
+    if tiers is None:
+        return (n,)
+    tiers = tuple(int(t) for t in tiers)
+    if _prod(tiers) != n or any(t < 2 for t in tiers):
+        raise CommError(
+            f"tier_stack {tiers} does not factor a {n}-rank world "
+            "into tiers of >= 2")
+    return tiers
+
+
+def synthesize_tiers(n: int, nbytes: int, itemsize: int = 4,
+                     tiers=None, tier_bandwidths=None,
+                     codec: str = "q8") -> Dict:
+    """The tier-dimension search: per-tier (algorithm × codec)
+    compositions ranked by the BANDWIDTH-WEIGHTED wire census
+    (:func:`.census.weighted_cost` over
+    :func:`.census.program_tier_census`), scored against the flat
+    ``bidir`` schedule — the strongest flat exact baseline, whose
+    whole-axis traffic all crosses the top (slowest) tier.  Candidates
+    are every ordered factorization chain of ``n`` (tier merging IS an
+    algorithm choice), each in its ``TIER_COMPOSITIONS`` variants.  The
+    lossy ``q8-slow`` variants exist only when some tier's bandwidth is
+    strictly below the fastest: with uniform bandwidths the search is
+    all-exact and the ranking degenerates to the unweighted census —
+    no regression by construction."""
+    from .programs import allreduce_program
+    from .. import config as _config
+    from .. import constants as C
+
+    nelems = max(1, nbytes // itemsize)
+    tiers = _resolved_tiers(n, tiers)
+    if tier_bandwidths is None:
+        tier_bandwidths = _config.tier_bandwidths()
+    if tier_bandwidths is None:
+        tier_bandwidths = (1.0,) * len(tiers)
+    bw = tuple(float(b) for b in tier_bandwidths)
+    if len(bw) != len(tiers):
+        raise CommError(
+            f"tier_bandwidths {bw} has {len(bw)} entries for the "
+            f"{len(tiers)}-tier stack {tiers}")
+    base = {"nranks": n, "nbytes": int(nbytes), "tiers": list(tiers),
+            "tier_bandwidths": list(bw)}
+    if n <= 1:
+        return dict(base, winner=None, exact_winner=None,
+                    beats_bidir=False, candidates=[])
+    bidir = allreduce_program("bidir", n, C.MPI_SUM,
+                              deterministic=False, nelems=nelems,
+                              itemsize=itemsize)
+    bidir_tier = program_tier_census(bidir, nelems, itemsize, tiers)
+    bidir_cost = weighted_cost(bidir_tier, bw)
+    slow = tuple(level for level, b in enumerate(bw) if b < max(bw))
+    candidates = []
+    for chain in factorization_chains(n):
+        exact = fold_program(n, chain, tiers)
+        variants = [("exact", exact)]
+        if slow and codec is not None:
+            lossy = rewrite_fold_codec(exact, slow, codec)
+            if lossy != exact:
+                variants.append(("q8-slow", lossy))
+        for comp, prog in variants:
+            per_tier = program_tier_census(prog, nelems, itemsize,
+                                           tiers)
+            cen = program_census(prog, nelems, itemsize)
+            candidates.append({
+                "chain": chain, "composition": comp, "program": prog,
+                "census": cen, "tier_wire": per_tier,
+                "weighted_cost": weighted_cost(per_tier, bw)})
+    ranked = sorted(
+        candidates,
+        key=lambda c: (c["weighted_cost"], c["census"]["seq_steps"],
+                       c["program"].digest()))
+    exact_ranked = [c for c in ranked if c["composition"] == "exact"]
+
+    def _entry(c):
+        return {"winner": SYNTH_PREFIX + c["program"].digest(),
+                "chain": list(c["chain"]),
+                "composition": c["composition"],
+                "program": c["program"], "census": c["census"],
+                "tier_wire": list(c["tier_wire"]),
+                "weighted_cost": c["weighted_cost"]}
+
+    win = _entry(ranked[0])
+    exact_win = _entry(exact_ranked[0])
+    return dict(
+        base,
+        bidir_tier_wire=list(bidir_tier),
+        bidir_weighted_cost=bidir_cost,
+        beats_bidir=bool(win["weighted_cost"] < bidir_cost),
+        exact_beats_bidir=bool(
+            exact_win["weighted_cost"] < bidir_cost),
+        candidates=[
+            {"chain": list(c["chain"]),
+             "composition": c["composition"],
+             "tier_wire": list(c["tier_wire"]),
+             "weighted_cost": c["weighted_cost"],
+             "seq_steps": c["census"]["seq_steps"]}
+            for c in ranked],
+        **{"winner": win["winner"], "chain": win["chain"],
+           "composition": win["composition"],
+           "program": win["program"], "census": win["census"],
+           "tier_wire": win["tier_wire"],
+           "weighted_cost": win["weighted_cost"],
+           "exact_winner": exact_win["winner"],
+           "exact_chain": exact_win["chain"],
+           "exact_program": exact_win["program"],
+           "exact_tier_wire": exact_win["tier_wire"],
+           "exact_weighted_cost": exact_win["weighted_cost"]})
 
 
 # ---------------------------------------------------------------------------
@@ -256,6 +413,58 @@ def autotune_synthesis(nranks: Optional[int] = None,
             _tune.record("allreduce", dtype, int(nbytes), n,
                          res["winner"], persist=persist, codec="synth",
                          program=prog.to_json())
+            ent["recorded"] = True
+        report["entries"][str(int(nbytes))] = ent
+    return report
+
+
+def autotune_tier_synthesis(nranks: Optional[int] = None,
+                            sizes=(1 << 10, 1 << 14, 1 << 18),
+                            dtype=None, persist: bool = True,
+                            tiers=None, tier_bandwidths=None) -> Dict:
+    """The tier-synthesis autotuner leg: run the weighted search per
+    size bucket, install the winners, and record them under the
+    tier-keyed cache slot (``make_key(..., tiers=)``).  The EXACT
+    winner records under ``codec="synth"`` — same slot discipline as
+    the flat leg; a lossy ``q8-slow`` winner records under
+    ``codec="synth_q8"``, a slot deterministic auto-selection never
+    consults, so compressed tier schedules stay explicit opt-in
+    (``algorithm="synth:<digest>"``) like every other codec."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import tune as _tune
+
+    if dtype is None:
+        dtype = jnp.float32
+    n = nranks or len(jax.devices())
+    itemsize = jnp.dtype(dtype).itemsize
+    tiers = _resolved_tiers(n, tiers)
+    report = {"collective": "allreduce", "nranks": n,
+              "tiers": list(tiers),
+              "dtype": str(jnp.dtype(dtype)), "entries": {}}
+    for nbytes in sizes:
+        res = synthesize_tiers(n, int(nbytes), itemsize, tiers=tiers,
+                               tier_bandwidths=tier_bandwidths)
+        ent = {k: res[k] for k in
+               ("winner", "chain", "composition", "tier_wire",
+                "weighted_cost", "exact_winner", "exact_tier_wire",
+                "exact_weighted_cost", "bidir_tier_wire",
+                "bidir_weighted_cost", "beats_bidir")}
+        if res["beats_bidir"] and n > 1:
+            exact = res["exact_program"]
+            install(exact)
+            _tune.record("allreduce", dtype, int(nbytes), n,
+                         res["exact_winner"], persist=persist,
+                         codec="synth", tiers=tiers,
+                         program=exact.to_json())
+            if res["winner"] != res["exact_winner"]:
+                prog = res["program"]
+                install(prog)
+                _tune.record("allreduce", dtype, int(nbytes), n,
+                             res["winner"], persist=persist,
+                             codec="synth_q8", tiers=tiers,
+                             program=prog.to_json())
             ent["recorded"] = True
         report["entries"][str(int(nbytes))] = ent
     return report

@@ -229,7 +229,7 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
                          compression=None, bucket_bytes=None,
                          mean: bool = False,
                          overlap: Optional[bool] = None,
-                         algorithm=None):
+                         algorithm=None, tier_window=None):
     """Allreduce every leaf of ``tree`` through dtype-homogeneous flat
     buckets — one collective (pair) per bucket instead of per leaf.
 
@@ -259,7 +259,14 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
     includes the bandwidth tier, so a compressed body bucket past the
     crossover rides the quantized ``bidir`` dual ring (in-schedule
     requantizing hops on both link rotations) and the two biggest wire
-    wins compose instead of excluding each other."""
+    wins compose instead of excluding each other.
+
+    ``tier_window`` widens the split-phase window on tier-stacked
+    communicators with a slow outer tier (see
+    :func:`mpi4torch_tpu.overlap.overlap_allreduce_tree`); ``None``
+    derives it from the configured ``tier_bandwidths`` skew
+    (:func:`mpi4torch_tpu.overlap.tier_window_depth` — no tier config,
+    no change)."""
     if mean and op != C.MPI_SUM:
         raise CommError(
             f"mean=True is the rank-mean of an MPI_SUM reduction; got "
@@ -374,7 +381,8 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
         # per-bucket codec/algorithm plan so the split-phase and
         # blocking schedules can never drift on which bucket rides
         # which wire.
-        from ..overlap import overlap_allreduce_tree, overlap_depth
+        from ..overlap import (overlap_allreduce_tree, overlap_depth,
+                               tier_window_depth)
 
         def plan(i, b):
             return _plan_bucket(
@@ -384,7 +392,9 @@ def fused_allreduce_tree(comm, tree, op: int = C.MPI_SUM, *,
 
         return overlap_allreduce_tree(
             comm, buckets, layout, op, depth=overlap_depth(overlap),
-            mean=mean, plan=plan)
+            mean=mean, plan=plan,
+            tier_window=(tier_window_depth() if tier_window is None
+                         else tier_window))
 
     # Phase 1: issue every bucket's reduction.  Exact-SUM buckets on the
     # SPMD mesh take the explicit reduce-scatter half of the ring (the

@@ -51,7 +51,8 @@ from .flight import dump_postmortem, format_postmortem
 from .metrics import (MetricsRegistry, StatsSourceRegistry, metrics_json,
                       percentile, prometheus_text, register_collector,
                       registry, reset_metrics, snapshot)
-from .reconcile import equivalent_wire, measured_wire_table, reconcile
+from .reconcile import (equivalent_tier_wire, equivalent_wire,
+                        measured_wire_table, reconcile)
 from .trace import (CommTracer, current_tracer, push_label,
                     spmd_collective_event, trace)
 
@@ -81,4 +82,5 @@ __all__ = [
     "measured_wire_table",
     "reconcile",
     "equivalent_wire",
+    "equivalent_tier_wire",
 ]

@@ -47,12 +47,19 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["measured_wire_table", "reconcile", "equivalent_wire"]
+__all__ = ["measured_wire_table", "reconcile", "equivalent_wire",
+           "equivalent_tier_wire"]
 
 
 # Cache of equivalent single-collective censuses, keyed by the logical
 # signature (head, shape, dtype, codec, algorithm, world size).
 _equiv_cache: Dict[tuple, Tuple[int, Dict[str, int]]] = {}
+
+# Cache of equivalent lowerings' StableHLO text under the same keying —
+# the tier breakdown (:func:`equivalent_tier_wire`) re-censuses the SAME
+# text per tier stack instead of re-lowering.
+_equiv_text_cache: Dict[tuple, str] = {}
+_equiv_tier_cache: Dict[tuple, List[int]] = {}
 
 
 # The heads the equivalent-lowering census can reproduce (their
@@ -70,23 +77,25 @@ def _needs_equivalent_lowering(ev) -> bool:
             or ev.algorithm not in (None, "auto", "ring"))
 
 
-def equivalent_wire(ev) -> Tuple[int, Dict[str, int]]:
-    """Per-device wire bytes and collective-kind counts of the Mode A
-    lowering equivalent to one Mode B collective event: the same facade
-    call (shape, dtype, codec, algorithm) lowered over an
-    ``ev.world_size``-device mesh and censused with
-    :func:`analyze.wire_bytes_per_device`.  Cached per logical
-    signature; needs >= ``world_size`` local (virtual) devices."""
+def _equiv_key(ev) -> tuple:
     from .. import config as _config
 
     # The equivalent lowering depends on the same trace-time knobs the
     # jit cache keys on (quant hop impl, ring chunk bytes, hier group,
-    # ...) — fold the fingerprint in so a config change never serves a
-    # stale census.
-    key = (ev.op, tuple(ev.shape or ()), ev.dtype, ev.codec,
-           ev.algorithm, ev.world_size,
-           _config.thresholds_fingerprint())
-    got = _equiv_cache.get(key)
+    # tier stack, ...) — fold the fingerprint in so a config change
+    # never serves a stale census.
+    return (ev.op, tuple(ev.shape or ()), ev.dtype, ev.codec,
+            ev.algorithm, ev.world_size,
+            _config.thresholds_fingerprint())
+
+
+def _equivalent_text(ev, key: tuple) -> str:
+    """StableHLO text of the Mode A lowering equivalent to one Mode B
+    collective event (same facade call — shape, dtype, codec, algorithm
+    — over an ``ev.world_size``-device mesh); cached per logical
+    signature so the total census and every tier breakdown re-census
+    ONE lowering.  Needs >= ``world_size`` local (virtual) devices."""
+    got = _equiv_text_cache.get(key)
     if got is not None:
         return got
     if ev.shape is None or ev.dtype is None:
@@ -99,7 +108,6 @@ def equivalent_wire(ev) -> Tuple[int, Dict[str, int]]:
     from jax.sharding import Mesh, PartitionSpec as P
 
     import mpi4torch_tpu as mpi
-    from .. import analyze
     from .._compat import shard_map
 
     n = ev.world_size
@@ -121,8 +129,44 @@ def equivalent_wire(ev) -> Tuple[int, Dict[str, int]]:
 
     lowered = jax.jit(shard_map(prog, mesh=mesh, in_specs=P(),
                                 out_specs=P(), check_vma=False)).lower(x)
-    got = analyze.wire_bytes_per_device(lowered)
+    text = lowered.as_text()
+    _equiv_text_cache[key] = text
+    return text
+
+
+def equivalent_wire(ev) -> Tuple[int, Dict[str, int]]:
+    """Per-device wire bytes and collective-kind counts of the Mode A
+    lowering equivalent to one Mode B collective event, censused with
+    :func:`analyze.wire_bytes_per_device`.  Cached per logical
+    signature; needs >= ``world_size`` local (virtual) devices."""
+    from .. import analyze
+
+    key = _equiv_key(ev)
+    got = _equiv_cache.get(key)
+    if got is not None:
+        return got
+    got = analyze.wire_bytes_per_device(_equivalent_text(ev, key))
     _equiv_cache[key] = got
+    return got
+
+
+def equivalent_tier_wire(ev, tiers) -> List[int]:
+    """Per-tier wire bytes of the equivalent Mode A lowering of one
+    Mode B collective event — :func:`analyze.tier_wire_table` over the
+    SAME cached lowering text :func:`equivalent_wire` censuses, so the
+    tier breakdown can only split the total, never disagree with it.
+    This is how grouped/compressed schedules (hier, tier-stack folds,
+    q8 pipelines) get their per-tier traffic priced EXACTLY: from the
+    replica groups of the actual lowering, not a formula."""
+    from .. import analyze
+
+    tiers = tuple(int(g) for g in tiers)
+    key = _equiv_key(ev) + (tiers,)
+    got = _equiv_tier_cache.get(key)
+    if got is not None:
+        return got
+    got = analyze.tier_wire_table(_equivalent_text(ev, key[:-1]), tiers)
+    _equiv_tier_cache[key] = got
     return got
 
 
@@ -155,8 +199,27 @@ def _formula_row(ev) -> Tuple[float, Dict[str, int]]:
             {ev.family: 1})
 
 
-def measured_wire_table(events: Iterable, rank: Optional[int] = None
-                        ) -> dict:
+def _formula_tier(ev, tiers: tuple) -> int:
+    """Tier of a formula-priced event: formula rows are plain ring-path
+    collectives whose replica group is a contiguous run of ranks, so a
+    group size matching the product of the first j tier factors spans
+    exactly tiers 0..j-1 (top differing digit j-1); anything else —
+    including the whole world — crosses the top tier.  Grouped schedules
+    whose groups are NOT contiguous runs (hier's strided inter-group
+    stage, tier-stack folds) never take this path: their algorithm label
+    routes them through the equivalent lowering, where the tier comes
+    from the actual replica groups."""
+    s = ev.group_size if ev.group_size else ev.world_size
+    p = 1
+    for j, g in enumerate(tiers):
+        p *= g
+        if s == p:
+            return j
+    return len(tiers) - 1
+
+
+def measured_wire_table(events: Iterable, rank: Optional[int] = None,
+                        tiers=None) -> dict:
     """Convert a Mode B event stream into the analyzer's census
     vocabulary: per-device wire bytes + per-kind collective counts.
 
@@ -165,7 +228,12 @@ def measured_wire_table(events: Iterable, rank: Optional[int] = None
     the SAME logical collective sequence (op, family, bytes, group) —
     the determinism property that makes the census a contract.  Returns
     ``{"wire_bytes", "counts", "logical_events", "by_op",
-    "per_rank_consistent", "excluded"}``."""
+    "per_rank_consistent", "excluded"}``; with a tier stack ``tiers``
+    the report additionally carries ``"tier_wire"`` — the per-tier
+    split of ``wire_bytes`` (equivalent-lowering rows read their tiers
+    from the actual replica groups via :func:`equivalent_tier_wire`,
+    formula rows from the contiguous-run rule), summing to the total
+    exactly."""
     events = list(events)
     evs = [e for e in events if e.channel == "exchange"]
     ranks = sorted({e.rank for e in evs})
@@ -210,14 +278,21 @@ def measured_wire_table(events: Iterable, rank: Optional[int] = None
     consistent = len({tuple(fingerprint(v)) for v in per_rank.values()}
                      ) <= 1
 
+    tiers = tuple(int(g) for g in tiers) if tiers is not None else None
+    tier_wire = [0.0] * len(tiers) if tiers is not None else None
     wire = 0.0
     counts: Dict[str, int] = {}
     by_op: Dict[str, dict] = {}
     for e in rows:
         if _needs_equivalent_lowering(e):
             b, c = equivalent_wire(e)
+            if tiers is not None:
+                for level, tw in enumerate(equivalent_tier_wire(e, tiers)):
+                    tier_wire[level] += tw
         else:
             b, c = _formula_row(e)
+            if tiers is not None:
+                tier_wire[_formula_tier(e, tiers)] += b
         wire += b
         for k, v in c.items():
             counts[k] = counts.get(k, 0) + v
@@ -228,7 +303,7 @@ def measured_wire_table(events: Iterable, rank: Optional[int] = None
         slot["payload_bytes"] += e.payload_bytes
     for slot in by_op.values():
         slot["wire_bytes"] = int(round(slot["wire_bytes"]))
-    return {
+    out = {
         "rank": use,
         "wire_bytes": int(round(wire)),
         "counts": counts,
@@ -238,11 +313,15 @@ def measured_wire_table(events: Iterable, rank: Optional[int] = None
         "ranks": ranks,
         "excluded": excluded,
     }
+    if tiers is not None:
+        out["tiers"] = list(tiers)
+        out["tier_wire"] = [int(round(w)) for w in tier_wire]
+    return out
 
 
 def reconcile(events_or_tracer, lowered_or_text,
               rank: Optional[int] = None,
-              dropped: Optional[int] = None) -> dict:
+              dropped: Optional[int] = None, tiers=None) -> dict:
     """Join a traced Mode B event stream against the ``analyze``
     predictions of the matching Mode A lowering.
 
@@ -257,8 +336,14 @@ def reconcile(events_or_tracer, lowered_or_text,
     wire bytes equal :func:`analyze.wire_bytes_per_device` of the
     lowering EXACTLY, (3) the measured per-kind collective counts equal
     the parse's counts exactly, and (4) the tracer dropped nothing
-    (a truncated census is not a census).  See the module docstring
-    for what is excluded and why."""
+    (a truncated census is not a census).  With a tier stack ``tiers``
+    (innermost first) the join additionally prices per-tier traffic —
+    measured (:func:`measured_wire_table` with ``tiers=``) against
+    predicted (:func:`analyze.tier_wire_table` of the lowering) — and
+    ``matches["tier_wire"]`` demands the split match EXACTLY too: the
+    runtime put its bytes on the tiers the static census says, not just
+    the right total.  See the module docstring for what is excluded and
+    why."""
     from .. import analyze
 
     events = events_or_tracer
@@ -268,7 +353,7 @@ def reconcile(events_or_tracer, lowered_or_text,
         events = events.events
     if dropped is None:
         dropped = 0
-    measured = measured_wire_table(events, rank=rank)
+    measured = measured_wire_table(events, rank=rank, tiers=tiers)
     pred_bytes, pred_counts = analyze.wire_bytes_per_device(
         lowered_or_text)
     try:
@@ -279,14 +364,20 @@ def reconcile(events_or_tracer, lowered_or_text,
         "wire_bytes": measured["wire_bytes"] == pred_bytes,
         "counts": measured["counts"] == pred_counts,
     }
+    predicted = {
+        "wire_bytes": pred_bytes,
+        "counts": pred_counts,
+        "scheduled_exposure": (exposure or {}).get(
+            "exposed_fraction") if exposure else None,
+    }
+    if tiers is not None:
+        predicted["tier_wire"] = analyze.tier_wire_table(
+            lowered_or_text, tiers)
+        matches["tier_wire"] = (measured["tier_wire"]
+                                == predicted["tier_wire"])
     report = {
         "measured": measured,
-        "predicted": {
-            "wire_bytes": pred_bytes,
-            "counts": pred_counts,
-            "scheduled_exposure": (exposure or {}).get(
-                "exposed_fraction") if exposure else None,
-        },
+        "predicted": predicted,
         "matches": matches,
         "dropped_events": int(dropped),
         "ok": bool(all(matches.values())

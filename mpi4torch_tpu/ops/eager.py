@@ -116,14 +116,34 @@ def _rendezvous_fold(world_size: int, algorithm,
         return "tree", C.reduce_tree
     if algorithm == "hier":
         # Shared group rule with the SPMD schedule (tune.
-        # resolve_hier_group) — one validity gate for both backends.
-        from ..tune import resolve_hier_group
+        # resolve_hier_group / resolve_tier_stack) — one validity gate
+        # for both backends.
+        from ..tune import resolve_hier_group, resolve_tier_stack
         try:
             g = resolve_hier_group(world_size)
+            stack = resolve_tier_stack(world_size)
         except CommError:
             if not explicit:
                 return ring
             raise
+        if len(stack) > 2:
+            # N-level config.tier_stack: fold in the same per-tier
+            # grouped-chain association Mode A's tier-annotated
+            # level_fold chain lowers (csched.programs, hier branch) —
+            # the 2-level reduce_grouped association would diverge
+            # bitwise from the compiled schedule.
+            from ..csched.interp import level_fold_groups
+            from ..csched.synth import chain_groups
+
+            levels = chain_groups(world_size, stack)
+
+            def _chain_fold(op, vals):
+                vals = list(vals)
+                for groups, _f in levels:
+                    vals = level_fold_groups(groups, op, vals)
+                return vals[0]
+
+            return "hier", _chain_fold
         return "hier", lambda op, vals: C.reduce_grouped(op, vals, g)
     if algorithm == "bidir":
         # The dual-ring halves are disjoint element ranges of an

@@ -1853,18 +1853,23 @@ class _bind_spmd:
         return False
 
 
-class HierMeshBackend:
-    """Two-tier communicator over TWO mesh axes ``(outer, inner)`` —
-    the topology-aware form of the ``hier`` algorithm, keyed off the
-    mesh axis sizes themselves (``comm_from_mesh(mesh, ("dp", "tp"))``):
-    ranks are row-major over (outer, inner), the inner axis is the fast
-    tier (ICI within a slice/host), the outer axis the slow one (DCN).
+class TierStackBackend:
+    """N-level communicator over N mesh axes, outermost (slowest
+    interconnect) first — the topology-aware tier stack
+    (``comm_from_mesh(mesh, ("pod", "host", "chip"))``): ranks are
+    row-major over the axes, the LAST axis is the fastest tier (ICI
+    within a slice/host), earlier axes progressively slower (DCN
+    across pods).  The 2-axis member is :class:`HierMeshBackend` — the
+    original hierarchical communicator, subsumed unchanged (2-axis
+    stacks delegate to the identical ``hier_allreduce_2d`` lowering, so
+    the StableHLO text cannot differ by construction).
 
-    Allreduce-only by design: the 2-level schedule — intra-group
-    (inner-axis) reduce-scatter → inter-group (outer-axis) allreduce →
-    intra-group all-gather — is what a 2D mesh buys; every other op
-    needs a single-axis communicator (``comm_from_mesh`` with one axis
-    name) and raises a :class:`CommError` pointing there."""
+    Allreduce-only by design: the staged per-tier schedule — innermost
+    reduce-scatter, recursing outward, innermost all-gather (or the
+    deterministic grouped-fold chain) — is what a multi-axis mesh buys;
+    every other op needs a single-axis communicator (``comm_from_mesh``
+    with one axis name) and raises a :class:`CommError` pointing
+    there."""
 
     # The facade degrades scope-default codecs on backends without a
     # compressed pipeline (and raises for explicit ones) — see
@@ -1886,33 +1891,64 @@ class HierMeshBackend:
         "allreduce_compressed", "allgather_compressed",
     })
 
-    def __init__(self, axis_names: Tuple[str, str],
-                 axis_sizes: Tuple[int, int]):
-        self.axis_names = tuple(axis_names)
-        self.axis_sizes = tuple(int(s) for s in axis_sizes)
+    def __init__(self, axis_names: Tuple[str, ...],
+                 axis_sizes: Tuple[int, ...]):
+        names = tuple(axis_names)
+        sizes = tuple(int(s) for s in axis_sizes)
+        if len(names) < 2 or len(names) != len(sizes):
+            raise CommError(
+                "a tier-stack communicator takes >= 2 mesh axis names "
+                f"(outermost first) with their sizes; got {names!r} / "
+                f"{sizes!r}")
+        self.axis_names = names
+        self.axis_sizes = sizes
 
     @property
     def rank(self):
-        outer, inner = self.axis_names
-        return (lax.axis_index(outer) * self.axis_sizes[1]
-                + lax.axis_index(inner))
+        r = lax.axis_index(self.axis_names[0])
+        for nm, s in zip(self.axis_names[1:], self.axis_sizes[1:]):
+            r = r * s + lax.axis_index(nm)
+        return r
 
     @property
     def size(self) -> int:
-        return self.axis_sizes[0] * self.axis_sizes[1]
+        p = 1
+        for s in self.axis_sizes:
+            p *= s
+        return p
 
     def allreduce(self, x, op, algorithm=None, algorithm_explicit=False):
-        return hier_allreduce_2d(self, x, op, algorithm,
+        if len(self.axis_names) == 2:
+            return hier_allreduce_2d(self, x, op, algorithm,
+                                     explicit=algorithm_explicit)
+        return tier_allreduce_nd(self, x, op, algorithm,
                                  explicit=algorithm_explicit)
 
     def __getattr__(self, name):
-        if name in HierMeshBackend._UNSUPPORTED_OPS:
+        if name in TierStackBackend._UNSUPPORTED_OPS:
             raise CommError(
-                "hierarchical 2-axis mesh communicators support "
-                f"Allreduce only (the 2-level wire schedule); {name!r} "
-                "needs a single-axis communicator — use "
+                "tier-stack mesh communicators support Allreduce only "
+                f"(the staged per-tier wire schedule); {name!r} needs "
+                "a single-axis communicator — use "
                 "comm_from_mesh(mesh, axis_name) with one axis")
         raise AttributeError(name)
+
+
+class HierMeshBackend(TierStackBackend):
+    """Two-tier communicator over TWO mesh axes ``(outer, inner)`` —
+    the topology-aware form of the ``hier`` algorithm, keyed off the
+    mesh axis sizes themselves (``comm_from_mesh(mesh, ("dp", "tp"))``):
+    the 2-level member of :class:`TierStackBackend`, kept as a named
+    class so 2-axis adoption, reshard's backend guard, and the original
+    2-level contract stay exactly what they were."""
+
+    def __init__(self, axis_names: Tuple[str, str],
+                 axis_sizes: Tuple[int, int]):
+        if len(tuple(axis_names)) != 2:
+            raise CommError(
+                "HierMeshBackend is the 2-axis tier stack; use "
+                f"TierStackBackend for {len(tuple(axis_names))} axes")
+        super().__init__(axis_names, axis_sizes)
 
 
 def _torus2d_fwd_value(hb: HierMeshBackend, x, op: int):
@@ -2030,31 +2066,137 @@ def hier_allreduce_2d(hb: HierMeshBackend, x, op: int, algorithm=None,
     return f(x)
 
 
+def _tier_sum_schedule(x, names, sizes):
+    """The N-level native SUM allreduce: grouped reduce-scatter over
+    the innermost (fastest) axis, the remaining axes' allreduce on the
+    shard, grouped all-gather back — the recursive generalization of
+    :func:`_grouped_sum_schedule` (whose 2-level body is exactly one
+    unrolling of this recursion).  Each level the payload shrinks by
+    that tier's factor before crossing the next (slower) tier —
+    the whole point of the stack: outer-tier bytes drop by the product
+    of every inner factor."""
+    if len(names) == 1:
+        return lax.psum(x, names[0])
+    inner_name, inner_size = names[-1], sizes[-1]
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    total = flat.size
+    seg = -(-total // inner_size)
+    if seg * inner_size != total:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros(seg * inner_size - total, dtype)])
+    xc = flat.reshape(inner_size, seg)
+    part = lax.psum_scatter(xc, inner_name, scatter_dimension=0,
+                            tiled=True)
+    part = _tier_sum_schedule(part, names[:-1], sizes[:-1])
+    out = lax.all_gather(part, inner_name, axis=0, tiled=True)
+    return out.reshape(-1)[:total].reshape(shape)
+
+
+def _tier_ordered_fold(x, op: int, names, sizes):
+    """Deterministic N-level grouped fold: one all-gather + ascending
+    fold per tier, innermost first — the chained form of
+    :func:`_grouped_ordered_fold` (whose 2-level body is exactly two
+    links of this chain), and the mesh-axis twin of the flat-world
+    ``level_fold`` chain (csched ``fold_program``): the association is
+    identical per tier, so Mode A/B parity per tier is the same
+    single-sourced contract."""
+    for nm, s in zip(reversed(names), reversed(sizes)):
+        stacked = lax.all_gather(x, nm, axis=0, tiled=False)
+        out = stacked[0]
+        for i in range(1, s):
+            out = C.combine2(op, out, stacked[i])
+        x = out
+    return x
+
+
+def _tier_fwd_value(tb: TierStackBackend, x, op: int, algorithm: str):
+    names, sizes = tb.axis_names, tb.axis_sizes
+    if tb.size == 1:
+        return x
+    det = _config.deterministic_reductions()
+    if not det and op == C.MPI_SUM:
+        if algorithm == "ring":
+            return lax.psum(x, names)
+        return _tier_sum_schedule(x, names, sizes)
+    if not det and op == C.MPI_MAX:
+        return lax.pmax(x, names)
+    if not det and op == C.MPI_MIN:
+        return lax.pmin(x, names)
+    if op in (C.MPI_MINLOC, C.MPI_MAXLOC):
+        C.combine2(op, x, x)  # raises with explanation
+    return _tier_ordered_fold(x, op, names, sizes)
+
+
+def tier_allreduce_nd(tb: TierStackBackend, x, op: int, algorithm=None,
+                      explicit: bool = False):
+    """Differentiable N-level allreduce over an N-axis tier stack
+    (N > 2; the 2-axis member routes through :func:`hier_allreduce_2d`
+    unchanged).  Same degrade/raise rule as the 2-axis form: explicit
+    single-ring-axis algorithms raise, scope defaults yield to ``hier``
+    — the stack's own staged schedule; ``torus`` needs exactly two
+    axes, so here it degrades/raises like the rest."""
+    if algorithm in (None, "auto"):
+        algorithm = "hier"
+    if algorithm not in ("hier", "ring"):
+        if not explicit:
+            algorithm = "hier"
+        else:
+            raise CommError(
+                f"an N-axis tier-stack communicator lowers algorithm "
+                f"'hier' (the staged per-tier schedule) or 'ring' "
+                f"(flat psum over all axes); got {algorithm!r} — "
+                "'torus' stripes over exactly two axes, and "
+                "rhd/tree/bidir need a single-axis communicator")
+
+    @jax.custom_vjp
+    def f(v):
+        return _tier_fwd_value(tb, v, op, algorithm)
+
+    def bwd(_, g):
+        if op != C.MPI_SUM:
+            raise RuntimeError(
+                f"Backward pass for Allreduce with {C.op_name(op)} is not "
+                "implemented — only MPI_SUM is differentiable (reference: "
+                "MPIUnimplementedNode, csrc/extension.cpp:194-202)"
+            )
+        with _bwd_scope("Allreduce"):
+            return (_tier_fwd_value(tb, g, C.MPI_SUM, algorithm),)
+
+    f.defvjp(lambda v: (_tier_fwd_value(tb, v, op, algorithm), None),
+             bwd)
+    return f(x)
+
+
 def comm_from_mesh(mesh, axis_name):
     """Adopt a mesh axis as a communicator for use inside the caller's own
     ``shard_map``/``pjit`` region — the TPU-native analogue of the
     reference's foreign-communicator interop (csrc/extension.cpp:168-171,
     src/__init__.py:247-261).
 
-    A TUPLE of two axis names ``(outer, inner)`` adopts both axes as a
-    two-tier hierarchical communicator (:class:`HierMeshBackend`): its
-    ``Allreduce`` runs the 2-level ``hier`` schedule keyed off the mesh
-    axis sizes — intra-``inner`` reduce-scatter, inter-``outer``
-    allreduce, intra-``inner`` all-gather."""
+    A TUPLE of axis names (outermost/slowest first) adopts them as a
+    tier-stack communicator: two names build the two-tier
+    :class:`HierMeshBackend` — ``Allreduce`` runs the 2-level ``hier``
+    schedule keyed off the mesh axis sizes (intra-``inner``
+    reduce-scatter, inter-``outer`` allreduce, intra-``inner``
+    all-gather) — and three or more build the N-level
+    :class:`TierStackBackend`, the same schedule staged per tier."""
     from ..comm import MPI_Communicator
 
     if isinstance(axis_name, (tuple, list)):
         names = tuple(axis_name)
-        if len(names) != 2:
+        if len(names) < 2:
             raise CommError(
-                "a hierarchical communicator takes exactly two axis "
-                f"names (outer, inner); got {names!r}")
+                "a tier-stack communicator takes two or more axis "
+                f"names (outermost first); got {names!r} — for one "
+                "axis pass the bare name")
         for nm in names:
             if nm not in mesh.axis_names:
                 raise CommError(
                     f"axis {nm!r} not in mesh axes {mesh.axis_names}")
         sizes = tuple(mesh.shape[nm] for nm in names)
-        backend = HierMeshBackend(names, sizes)
+        backend = (HierMeshBackend(names, sizes) if len(names) == 2
+                   else TierStackBackend(names, sizes))
         comm = MPI_Communicator(lambda: backend)
         comm._hier_axes = (names, sizes)
         return comm

@@ -77,6 +77,7 @@ __all__ = [
     "complete_generic",
     "resolve_overlap",
     "overlap_depth",
+    "tier_window_depth",
     "overlap_allreduce_tree",
     "overlap_reduce_scatter_tree",
     "overlap_split_allreduce",
@@ -257,6 +258,26 @@ def overlap_depth(value, default: int = 2) -> int:
     """Prefetch window depth of a truthy overlap value (``True`` → the
     double-buffered default of 2)."""
     return default if value is True else max(int(value), 1)
+
+
+def tier_window_depth():
+    """The configured tier-stack overlap widening, or ``None`` when the
+    config declares no bandwidth skew: with ``config.tier_stack`` AND
+    ``config.tier_bandwidths`` set and the slowest tier strictly slower
+    than the fastest, a bucket's collective spends ~``max(bw)/min(bw)``
+    of its wall time on the slow tier — so the split-phase window must
+    hold that many buckets (plus the double-buffer slot) in flight for
+    the slow tier's pipe to stay full while faster phases turn over.
+    Deterministic in the config fingerprint (both knobs ride
+    ``thresholds_fingerprint``), so a jit retrace sees any change."""
+    stack = _config.tier_stack()
+    bws = _config.tier_bandwidths()
+    if stack is None or bws is None or len(bws) != len(stack):
+        return None
+    lo, hi = min(bws), max(bws)
+    if not lo < hi:
+        return None
+    return int(-(-hi // lo)) + 1
 
 
 # Scheduler entry points (public API; the fused tree facade and the

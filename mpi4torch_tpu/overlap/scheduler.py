@@ -152,7 +152,7 @@ def overlap_split_allreduce(comm, x, op: int, *, nsplits: int = 2,
 
 def overlap_allreduce_tree(comm, buckets: Sequence, layout, op: int, *,
                            depth: int = 2, mean: bool = False,
-                           plan=None):
+                           plan=None, tier_window=None):
     """Windowed split-phase allreduce over pre-flattened buckets.
 
     ``plan(i, bucket) -> (codec, algorithm)`` is the per-bucket
@@ -160,10 +160,24 @@ def overlap_allreduce_tree(comm, buckets: Sequence, layout, op: int, *,
     (fuse/collectives.py); compressed buckets take the blocking codec
     pipeline in their start slot, exact buckets ride start/wait pairs.
     Returns the reduced bucket list (``mean`` folds the rank-mean into
-    one post-wait scale per bucket)."""
+    one post-wait scale per bucket).
+
+    ``tier_window`` is the tier-stack widening: on a communicator whose
+    tier stack has a slow outermost tier (skewed
+    ``config.tier_bandwidths`` — DCN under ICI), each bucket's
+    collective spends most of its wall time in the outer-tier phase, so
+    a ``depth``-bucket window drains to one transfer in flight while an
+    outer phase completes.  A truthy ``tier_window`` widens the window
+    to ``min(tier_window, nb)`` buckets (never narrows below ``depth``),
+    so start→wait spans cross enough bucket boundaries to keep the slow
+    tier's pipe full — statically visible as a strictly-below-blocking
+    :func:`~mpi4torch_tpu.overlap.scheduled_exposure` fraction over the
+    widened spans."""
     from ..fuse.bucketing import unflatten_buckets
 
     nb = len(buckets)
+    if tier_window:
+        depth = max(int(depth), min(int(tier_window), nb))
     size = comm.size
     win = _Window(comm, "Allreduce_tree", nb)
 

@@ -369,10 +369,10 @@ def execute_plan(comm, plan: ReshardPlan, x, codec=None):
     """Run a compiled plan on ``comm``'s backend (no AD wrapper — use
     :func:`reshard_value` for the differentiable form)."""
     from ..comm import _EagerBackend
-    from ..ops.spmd import HierMeshBackend, SpmdBackend
+    from ..ops.spmd import SpmdBackend, TierStackBackend
 
     backend = comm._backend()
-    if isinstance(backend, HierMeshBackend):
+    if isinstance(backend, TierStackBackend):
         raise CommError(
             "Reshard needs a flat communicator (the virtual mesh lives "
             "in the Layouts); use comm_from_mesh with ONE axis name or "

@@ -59,6 +59,7 @@ __all__ = [
     "register_algorithm",
     "resolve_request",
     "resolve_hier_group",
+    "resolve_tier_stack",
     "select_auto",
     "codec_algorithms",
     "autotune_allreduce",
@@ -149,6 +150,17 @@ def resolve_hier_group(nranks: int) -> int:
                 f"split of the {nranks}-rank communicator (need a "
                 f"divisor with 1 < g < {nranks})")
         return g
+    ts = _config.tier_stack()
+    if ts is not None:
+        # The tier stack generalizes hier_group_size: its innermost
+        # factor IS the intra-group size of the 2-level view (the
+        # outer tiers merge into the inter-group stage).
+        stack = resolve_tier_stack(nranks)
+        if len(stack) < 2:
+            raise CommError(
+                f"config.tier_stack={stack} is a single flat tier — "
+                f"the 'hier' schedule needs >= 2 levels")
+        return stack[0]
     g = best_group(nranks)
     if g is None:
         raise CommError(
@@ -156,6 +168,29 @@ def resolve_hier_group(nranks: int) -> int:
             f"of the world size; {nranks} has no nontrivial divisor — "
             "use 'tree' or 'ring'")
     return g
+
+
+def resolve_tier_stack(nranks: int):
+    """THE flat-axis tier-stack factorization (innermost first) of an
+    ``nranks`` communicator — the single source the grouped-fold chain
+    builders and the weighted census consult.
+    ``config.tier_stack()`` when set (validated against THIS
+    communicator), else the 2-level ``(g, nranks // g)`` split of
+    :func:`resolve_hier_group` — so with nothing configured the stack
+    IS today's hier pair and nothing changes."""
+    ts = _config.tier_stack()
+    if ts is not None:
+        stack = tuple(int(g) for g in ts)
+        p = 1
+        for g in stack:
+            p *= g
+        if p != nranks or any(g < 2 for g in stack):
+            raise CommError(
+                f"config.tier_stack={stack} does not factor the "
+                f"{nranks}-rank communicator into tiers of >= 2")
+        return stack
+    g = resolve_hier_group(nranks)
+    return (g, nranks // g)
 
 
 def select_auto(*, collective: str = "allreduce", nbytes: int,

@@ -25,7 +25,7 @@ from typing import Iterable, List, Optional
 from .autotuner import CACHE_VERSION, cache_path
 
 _COLUMNS = ("collective", "dtype", "size<=", "nranks", "platform",
-            "algorithm", "source")
+            "tiers", "algorithm", "source")
 
 
 def _load_raw() -> Optional[dict]:
@@ -48,7 +48,11 @@ def _program_steps(ent: dict) -> int:
 def _rows(data: dict) -> List[tuple]:
     """Decode ``collective|dtype|bucket|nranks|platform`` keys into table
     rows; malformed entries are skipped, not fatal — this is a debugging
-    surface over a best-effort cache.  Synthesized-program winners
+    surface over a best-effort cache.  Trailing key dimensions are
+    optional and ordered (``|codec=…`` then ``|tiers=…``): codec-keyed
+    winners render with the slot tag on the collective column,
+    tier-keyed winners (csched tier-stack synthesis) fill the ``tiers``
+    column (``-`` for flat keys).  Synthesized-program winners
     (``synth:<digest>`` entries carrying their serialized IR program,
     mpi4torch_tpu.csched) render distinctly from named algorithms: the
     digest in the algorithm column, ``synthesized(<n> steps)`` as the
@@ -64,10 +68,14 @@ def _rows(data: dict) -> List[tuple]:
         algo = ent.get("algorithm")
         if not isinstance(algo, str):
             continue
+        tiers = "-"
+        if len(parts) > 5 and parts[-1].startswith("tiers="):
+            tiers = parts[-1][len("tiers="):]
+            parts = parts[:-1]
         if len(parts) == 6 and parts[5].startswith("codec="):
             # Codec-keyed winners (compressed traffic's own slots, and
-            # codec=synth — the synthesis dimension) render with the
-            # slot tag on the collective column.
+            # codec=synth / codec=synth_q8 — the synthesis dimensions)
+            # render with the slot tag on the collective column.
             parts = [parts[0] + "[" + parts[5][len("codec="):] + "]"] \
                 + parts[1:5]
         if len(parts) != 5:
@@ -80,8 +88,8 @@ def _rows(data: dict) -> List[tuple]:
             source = "measured"
         else:
             source = "recorded"
-        rows.append((collective, dtype, bucket, nranks, platform, algo,
-                     source))
+        rows.append((collective, dtype, bucket, nranks, platform, tiers,
+                     algo, source))
     return rows
 
 

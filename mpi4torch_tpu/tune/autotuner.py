@@ -43,7 +43,12 @@ from .registry import available_algorithms, get_algorithm
 # (ISSUE 7 satellite — a single preempted/GC-hit sample could poison a
 # persisted winner under the median with few iters); winners measured
 # under the old rule are discarded by the version gate.
-CACHE_VERSION = 2
+# v3: synthesized-program keys grew a tier dimension (|tiers=AxB...)
+# and fold steps carry tier annotations (Step.tier) that change synth
+# digests — v2 entries naming pre-tier digests are silently discarded
+# by the version gate (selection falls back to the defaults until the
+# census sweep re-records; _load ignores mismatched versions).
+CACHE_VERSION = 3
 
 _mem: Dict[str, dict] = {}
 _from_disk: set = set()
@@ -92,9 +97,19 @@ def _codec_name(codec) -> Optional[str]:
     return getattr(codec, "name", codec)
 
 
+def _tiers_token(tiers) -> Optional[str]:
+    """Normalize a tier-stack key dimension value (a tuple of factors,
+    an ``AxBxC`` string, or None) to a cache-key token."""
+    if tiers is None:
+        return None
+    if isinstance(tiers, str):
+        return tiers
+    return "x".join(str(int(g)) for g in tiers)
+
+
 def make_key(collective: str, dtype, nbytes: int, nranks: int,
              platform: Optional[str] = None, codec=None,
-             transition: Optional[str] = None) -> str:
+             tiers=None, transition: Optional[str] = None) -> str:
     import numpy as np
 
     if platform is None:
@@ -109,6 +124,14 @@ def make_key(collective: str, dtype, nbytes: int, nranks: int,
     name = _codec_name(codec)
     if name is not None:
         key += "|codec=" + str(name)
+    # The tier dimension (mpi4torch_tpu.csched tier-stack synthesis): a
+    # winner ranked by the bandwidth-weighted census is specific to the
+    # tier-stack factorization it was searched under — a (2,2,2) stack's
+    # winner must never serve a (4,2) world.  Same growth pattern as the
+    # codec dimension; flat (un-tiered) keys stay byte-identical.
+    tok = _tiers_token(tiers)
+    if tok is not None:
+        key += "|tiers=" + str(tok)
     # The transition dimension (mpi4torch_tpu.reshard): a measured
     # redistribution winner is specific to its (layout, layout', shape)
     # transition — the same growth pattern as the codec dimension, so
@@ -251,7 +274,7 @@ def _save() -> None:
 
 
 def lookup(collective: str, dtype, nbytes: int, nranks: int,
-           platform: Optional[str] = None, codec=None,
+           platform: Optional[str] = None, codec=None, tiers=None,
            transition: Optional[str] = None) -> Optional[dict]:
     """The cached entry for this key, or None.  Entries naming an
     algorithm (or reshard strategy) the owning registry no longer knows
@@ -260,7 +283,8 @@ def lookup(collective: str, dtype, nbytes: int, nranks: int,
 
     _load()
     ent = _mem.get(make_key(collective, dtype, nbytes, nranks, platform,
-                            codec=codec, transition=transition))
+                            codec=codec, tiers=tiers,
+                            transition=transition))
     if ent is None:
         _metrics.inc("tune_cache_misses_total",
                      help="autotuner cache lookups that found no winner")
@@ -277,27 +301,28 @@ def lookup(collective: str, dtype, nbytes: int, nranks: int,
 
 def lookup_algorithm(collective: str, dtype, nbytes: int, nranks: int,
                      platform: Optional[str] = None,
-                     codec=None,
+                     codec=None, tiers=None,
                      transition: Optional[str] = None) -> Optional[str]:
     ent = lookup(collective, dtype, nbytes, nranks, platform, codec=codec,
-                 transition=transition)
+                 tiers=tiers, transition=transition)
     return None if ent is None else ent["algorithm"]
 
 
 def entry_from_disk(collective: str, dtype, nbytes: int, nranks: int,
-                    platform: Optional[str] = None, codec=None) -> bool:
+                    platform: Optional[str] = None, codec=None,
+                    tiers=None) -> bool:
     """True when this key's entry was loaded from the persisted file
     (rather than measured in this process) — the bench's
     ``tuned_from_cache`` evidence."""
     _load()
     return make_key(collective, dtype, nbytes, nranks,
-                    platform, codec=codec) in _from_disk
+                    platform, codec=codec, tiers=tiers) in _from_disk
 
 
 def record(collective: str, dtype, nbytes: int, nranks: int,
            algorithm: str, platform: Optional[str] = None,
            measurements: Optional[dict] = None,
-           persist: bool = True, codec=None,
+           persist: bool = True, codec=None, tiers=None,
            transition: Optional[str] = None,
            program: Optional[dict] = None) -> str:
     """Store a winner for a key (and persist).  Bumps the selection
@@ -310,7 +335,7 @@ def record(collective: str, dtype, nbytes: int, nranks: int,
     global _generation
     _load()
     key = make_key(collective, dtype, nbytes, nranks, platform,
-                   codec=codec, transition=transition)
+                   codec=codec, tiers=tiers, transition=transition)
     ent = {"algorithm": algorithm, "measured_at": time.time()}
     if program is not None:
         ent["program"] = program
@@ -318,6 +343,9 @@ def record(collective: str, dtype, nbytes: int, nranks: int,
     name = _codec_name(codec)
     if name is not None:
         ent["codec"] = str(name)
+    tok = _tiers_token(tiers)
+    if tok is not None:
+        ent["tiers"] = str(tok)
     if measurements:
         ent["measurements"] = measurements
     _mem[key] = ent

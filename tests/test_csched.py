@@ -479,9 +479,9 @@ class TestSynthesis:
         csched.autotune_synthesis(nranks=NR, sizes=(1 << 14,))
         data = json.load(open(mpi.tune.cache_path()))
         rows = _rows(data)
-        synth_rows = [r for r in rows if r[5].startswith("synth:")]
+        synth_rows = [r for r in rows if r[6].startswith("synth:")]
         assert synth_rows
-        assert synth_rows[0][6] == "synthesized(3 steps)"
+        assert synth_rows[0][7] == "synthesized(3 steps)"
 
     def test_synth_degrades_when_not_installed(self):
         # Scope default naming an uninstalled synth program degrades to

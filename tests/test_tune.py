@@ -875,9 +875,12 @@ class TestCacheCli:
         out = capsys.readouterr().out
         # one row per key: collective, dtype, size bucket, nranks,
         # platform -> algorithm
-        assert re.search(r"allreduce\s+float32\s+512\s+8\s+cpu\s+rhd", out)
-        assert re.search(r"allreduce\s+float32\s+4194304\s+8\s+cpu\s+bidir",
+        # one row per key; flat (untied) entries show "-" in the tiers
+        # column
+        assert re.search(r"allreduce\s+float32\s+512\s+8\s+cpu\s+-\s+rhd",
                          out)
+        assert re.search(
+            r"allreduce\s+float32\s+4194304\s+8\s+cpu\s+-\s+bidir", out)
         assert "2 cached winner(s)" in out
 
     def test_show_empty_and_missing_cache(self, capsys):
