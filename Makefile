@@ -1,7 +1,7 @@
 # Repo entry points.  `make docs` prefers Sphinx (doc/conf.py, the
 # reference-parity build) and falls back to the stdlib-only generator so
 # HTML docs build in any environment.
-.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke tiers-smoke transport-smoke bench-sweep tpu-test native clean-docs
+.PHONY: docs test tier1 tune-smoke overlap-smoke quant-smoke faults-smoke chaos-smoke reshard-smoke serve-smoke analyze-smoke obs-smoke elastic-smoke ir-smoke tiers-smoke transport-smoke ctl-smoke bench-sweep tpu-test native clean-docs
 
 docs:
 	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
@@ -225,6 +225,24 @@ transport-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m mpi4torch_tpu.transport --smoke
+
+# CPU smoke run of the online self-tuning controller
+# (mpi4torch_tpu.ctl, ISSUE 19): live per-tier bandwidth estimation
+# over the CommEvent stream (EWMA attribution checked exactly on a
+# synthetic stream), the no-flap hysteresis property, and the
+# deterministic closed-loop brownout cell — an injected outer-tier
+# brownout drives the controller through an epoch-fenced consensus to
+# the q8/synth_q8 winner (bitwise vs the explicit-q8 oracle, throttled
+# wire bytes shrink, stale pre-switch views FENCED with
+# StaleEpochError), clearing the fault de-escalates bitwise back to
+# the pre-episode configuration — plus the DEGRADE_POLICIES fast path
+# landing in the same decision ledger, the controller-off
+# bit-identical off path, and the trigger-kind registry-sync guard.
+# Exits non-zero on any divergence.
+ctl-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m mpi4torch_tpu.ctl --smoke
 
 # Fast bench lane: ONLY the per-algorithm allreduce size sweep (the
 # sizes × algorithms GB/s table + measured latency/bandwidth

@@ -2233,6 +2233,77 @@ def _bench_transport(on_tpu: bool):
     }
 
 
+def _bench_ctl(on_tpu: bool):
+    """Self-tuning controller stanza (ISSUE 19): the deterministic
+    closed loop — a per-byte brownout on the episode's one collective
+    drives the EWMA goodput estimate under the low watermark, the
+    controller escalates to the q8/synth_q8 winner through an
+    epoch-fenced consensus (the escalated phase is asserted bitwise
+    against the explicit-q8 oracle), the fault clears, and the
+    de-escalation restores the pre-episode configuration bitwise.  The
+    recorded verdict is census arithmetic (weighted cost, per-tier
+    wire) plus the ledger's own account of WHY it switched; also pinned
+    here: the controller-off discipline — constructing and polling a
+    disabled controller leaves the jitted lowering text bit-identical."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import mpi4torch_tpu as mpi
+    from mpi4torch_tpu._compat import shard_map
+    from mpi4torch_tpu.ctl import SelfTuningController
+    from mpi4torch_tpu.ctl.__main__ import closed_loop_episode
+
+    mesh = Mesh(np.asarray(jax.devices()), ("w",))
+    cm = mpi.comm_from_mesh(mesh, "w")
+    probe = jnp.arange(256, dtype=jnp.float32)
+
+    def lowered():
+        return jax.jit(shard_map(
+            lambda a: cm.Allreduce(a, mpi.MPI_SUM),
+            mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False)).lower(probe).as_text()
+
+    text_before = lowered()
+    off = SelfTuningController(n_ranks=8, tiers=(2, 2, 2))
+    off.poll()
+    off_identical = lowered() == text_before
+
+    ev = closed_loop_episode(n=8, tiers=(2, 2, 2), backend="thread")
+    esc, rec = ev["escalation"], ev["recovery"]
+    bitwise_escalated = all(
+        np.array_equal(g, w)
+        for g, w in zip(ev["escalated"], ev["oracle_q8"]))
+    bitwise_recovered = all(
+        np.array_equal(g, w)
+        for g, w in zip(ev["recovered"], ev["exact_before"]))
+    return {
+        "mode": "deterministic closed loop (eager thread backend)",
+        "escalation_trigger": esc.trigger if esc else None,
+        "escalation_epoch": esc.epoch if esc else None,
+        "weighted_cost_before": esc.old["weighted_cost"] if esc else None,
+        "weighted_cost_after": esc.new["weighted_cost"] if esc else None,
+        "tier_wire_before": esc.old["tier_wire"] if esc else None,
+        "tier_wire_after": esc.new["tier_wire"] if esc else None,
+        "cost_reduction": round(
+            esc.old["weighted_cost"] / max(esc.new["weighted_cost"], 1e-9),
+            3) if esc else None,
+        "compression_during": ev["compression_during"],
+        "bitwise_vs_q8_oracle": bitwise_escalated,
+        "stale_view_fenced": ev["stale_fenced"],
+        "recovery_trigger": rec.trigger if rec else None,
+        "recovery_epoch": rec.epoch if rec else None,
+        "compression_after": ev["compression_after"],
+        "bitwise_vs_pre_episode": bitwise_recovered,
+        "ledger_triggers": ev["ledger"].triggers(),
+        "controller_off_lowering_identical": off_identical,
+        "note": ("brownout -> crossover escalation -> recovery; every "
+                 "switch consensus-ratified, both phase results bitwise "
+                 "against their oracles"),
+    }
+
+
 def _guarded(name: str, fn, *args):
     """Run one sub-bench; on ANY failure return an error stanza instead of
     propagating (a completed earlier measurement must survive a later
@@ -2321,6 +2392,7 @@ def main() -> None:
                        on_tpu)
         tirs = _guarded("allreduce_tiers", _bench_allreduce_tiers, on_tpu)
         trn = _guarded("transport", _bench_transport, on_tpu)
+        ctlr = _guarded("ctl", _bench_ctl, on_tpu)
         flash_res = _guarded("flash", _bench_flash, on_tpu, peak)
         ratio_res = _guarded("flash_reference_ratio",
                              _bench_flash_reference_ratio, on_tpu)
@@ -2364,6 +2436,7 @@ def main() -> None:
             "schedule_synthesis": syn,
             "allreduce_tiers": tirs,
             "transport": trn,
+            "ctl": ctlr,
             "peak_flops_assumed": peak,
             "hbm_gbps_assumed": hbm,
             "flash_attention_fwd_bwd": flash_res,

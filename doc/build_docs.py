@@ -27,7 +27,7 @@ OUT = DOC / "html"
 PAGES = ["index", "basic_usage", "examples", "parallelism", "serving",
          "compression", "fusion", "algorithms", "schedule_ir", "overlap",
          "resilience", "reshard", "elasticity", "transport", "analysis",
-         "observability", "api_reference",
+         "observability", "self_tuning", "api_reference",
          "design_tpu", "glossary"]
 
 CSS = """

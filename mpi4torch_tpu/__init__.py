@@ -74,6 +74,7 @@ from . import analyze
 from . import csched
 from . import obs
 from . import elastic
+from . import ctl
 from .config import (algorithm_scope, compression_scope, fusion_scope,
                      overlap_scope)
 from .overlap import SpmdWaitHandle
@@ -127,6 +128,7 @@ __all__ = [
     "csched",
     "obs",
     "elastic",
+    "ctl",
     "SpmdWaitHandle",
     "FaultPlan",
     "FaultSpec",

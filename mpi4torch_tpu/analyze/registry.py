@@ -39,6 +39,7 @@ __all__ = [
     "csched_problems",
     "tier_program_problems",
     "transport_problems",
+    "ctl_problems",
     "standing_problems",
 ]
 
@@ -429,6 +430,42 @@ def transport_problems() -> List[str]:
         "backend must pass the bitwise parity matrix")
 
 
+# -------------------------------------------------------------------- ctl
+
+def ctl_problems() -> List[str]:
+    """Self-tuning controller registry sync (ISSUE 19): the decision
+    ledger's trigger vocabulary (``ctl.ledger.TRIGGER_KINDS``), the
+    ctl-smoke lane's coverage literal (``ctl.__main__.LEDGER_COVERED``)
+    and the degrade-policy delegation map
+    (``ctl.controller.POLICY_TRIGGER``) must move together — a new
+    trigger kind cannot ship without a smoke cell that records it, and
+    a new degrade policy cannot ship outside the controller's ONE
+    switching mechanism (every DEGRADE_POLICIES entry must delegate to
+    a registered trigger)."""
+    from ..ctl.__main__ import LEDGER_COVERED
+    from ..ctl.controller import POLICY_TRIGGER
+    from ..ctl.ledger import TRIGGER_KINDS
+    from ..resilience.degrade import DEGRADE_POLICIES
+
+    problems = set_drift(
+        TRIGGER_KINDS, LEDGER_COVERED,
+        "ledger trigger kinds {registered} out of sync with the "
+        "ctl-smoke coverage literal {covered} — every trigger kind "
+        "needs a smoke cell that records a ledgered switch")
+    problems += set_drift(
+        DEGRADE_POLICIES, POLICY_TRIGGER,
+        "degrade-policy registry {registered} out of sync with the "
+        "controller's delegation map {covered} — every policy must "
+        "route through the controller's ratified switch "
+        "(ctl.controller.POLICY_TRIGGER)")
+    stray = sorted(set(POLICY_TRIGGER.values()) - set(TRIGGER_KINDS))
+    if stray:
+        problems.append(
+            f"POLICY_TRIGGER delegates to unregistered trigger "
+            f"kind(s) {stray} — the ledger would refuse the record")
+    return problems
+
+
 # ------------------------------------------------------------- everything
 
 def standing_problems() -> List[str]:
@@ -445,6 +482,7 @@ def standing_problems() -> List[str]:
     problems += [f"csched: {p}" for p in csched_problems()]
     problems += [f"csched: {p}" for p in tier_program_problems()]
     problems += [f"transport: {p}" for p in transport_problems()]
+    problems += [f"ctl: {p}" for p in ctl_problems()]
     from ..serve.__main__ import PARITY_POLICIES
     problems += [f"serve: {p}"
                  for p in serve_policy_problems(PARITY_POLICIES)]

@@ -317,6 +317,12 @@ class DegradeController:
         self.runtime = runtime
         self.transitions: List[DegradeTransition] = []
         self._saved: Dict[str, Tuple] = {}
+        # Decision ledger (mpi4torch_tpu.ctl.ledger.DecisionLedger):
+        # None on a bare DegradeController; the SelfTuningController
+        # subclass installs one so fault-path transitions land in the
+        # same "why did we switch" record as drift/crossover/recovery
+        # switches.
+        self.ledger = None
 
     def _save_once(self, key: str, value, setter) -> None:
         """Snapshot a knob the FIRST time a policy touches it, so
@@ -333,11 +339,16 @@ class DegradeController:
             raise DegradeError(
                 f"unknown degrade policy {policy!r}; registered: "
                 f"{sorted(DEGRADE_POLICIES)}")
-        if consensus:
-            view = self.runtime.consensus()
-        else:
-            view = self.runtime.view
-        action = fn(self, report, **kw)
+        # ONE switching mechanism (ISSUE 19): the consensus round, the
+        # process-wide mutation and the record all run through the
+        # controller's ratified_switch — the fault fast path and the
+        # measurement-triggered drift/crossover/recovery switches are
+        # the same code with different triggers.
+        from ..ctl.controller import POLICY_TRIGGER, ratified_switch
+
+        view, action = ratified_switch(
+            self, lambda host, _view: fn(host, report, **kw),
+            consensus=consensus)
         tr = DegradeTransition(
             epoch=view.epoch, policy=policy, action=action,
             slow=tuple(sorted(report.slow)) if report is not None
@@ -348,6 +359,15 @@ class DegradeController:
         _metrics.inc(f'degrade_transitions_total{{policy="{policy}"}}',
                      help="epoch-fenced degrade-mode transitions by "
                           "policy (resilience.degrade)")
+        if self.ledger is not None:
+            est = getattr(self, "estimator", None)
+            self.ledger.record(
+                view.epoch, POLICY_TRIGGER.get(policy, "fault"),
+                policy=policy,
+                estimates=est.tier_estimates() if est is not None
+                else (),
+                new=dict(action),
+                note=f"policy={policy} slow={tr.slow}")
         return tr
 
     def reset(self) -> None:

@@ -401,6 +401,9 @@ class Engine:
         self._next_rid = 0
         self.slot_log: List[tuple] = []   # (rid, slot) admission history
         self.stats = _prof._register_serve_stats(_prof.ServeStats())
+        # Optional self-tuning controller (mpi4torch_tpu.ctl): consulted
+        # between steps, never during one — see attach_controller.
+        self._controller = None
 
     # ------------------------------------------------------------- traced
 
@@ -1015,6 +1018,13 @@ class Engine:
         ``deadline_expired`` result status) after the sweep that runs
         BEFORE admission — an expired queued request never burns a
         prefill."""
+        # Between-steps controller consult (mpi4torch_tpu.ctl): a step
+        # boundary is the only safe switch point — no collective is in
+        # flight, so a ratified codec/schedule switch takes effect on
+        # the NEXT step's traffic atomically.  Disabled (the default)
+        # or detached, this is one attribute read.
+        if self._controller is not None:
+            self._controller.poll()
         events = {"admitted": [], "emitted": {}, "finished": [],
                   "expired": []}
         self._expire_sweep(events)
@@ -1156,6 +1166,16 @@ class Engine:
                  "key": r.key,
                  "deadline": r.deadline,
                  "pages": pages.get(id(r))} for r in recs]
+
+    def attach_controller(self, controller) -> None:
+        """Attach a :class:`mpi4torch_tpu.ctl.SelfTuningController`:
+        every subsequent :meth:`step` consults ``controller.poll()``
+        FIRST (the between-steps switch point — a ratified switch lands
+        before the step's collectives are issued, never mid-step).
+        With ``config.ctl_enabled()`` False (the default) the consult
+        is one knob read and the engine's behavior is unchanged;
+        ``attach_controller(None)`` detaches."""
+        self._controller = controller
 
     def snapshot_inflight(self) -> List[dict]:
         """Non-destructive :meth:`drain`: the same records, with the

@@ -56,7 +56,11 @@ def _rows(data: dict) -> List[tuple]:
     (``synth:<digest>`` entries carrying their serialized IR program,
     mpi4torch_tpu.csched) render distinctly from named algorithms: the
     digest in the algorithm column, ``synthesized(<n> steps)`` as the
-    source."""
+    source.  Entries the self-tuning controller installed ONLINE
+    (mpi4torch_tpu.ctl — a live drift/crossover episode, not an
+    offline sweep) carry a ``ctl`` provenance stamp and render as
+    ``online-switched(<trigger>@epoch <n>, k steps)`` so an operator
+    can tell which winners a controller episode picked."""
     rows = []
     entries = data.get("entries")
     if not isinstance(entries, dict):
@@ -81,8 +85,16 @@ def _rows(data: dict) -> List[tuple]:
         if len(parts) != 5:
             continue
         collective, dtype, bucket, nranks, platform = parts
-        if algo.startswith("synth:") and isinstance(ent.get("program"),
-                                                    dict):
+        ctl = ent.get("ctl")
+        if isinstance(ctl, dict) and ctl.get("provenance") \
+                == "online-switched":
+            source = (f"online-switched({ctl.get('trigger', '?')}"
+                      f"@epoch {ctl.get('epoch', '?')}")
+            if isinstance(ent.get("program"), dict):
+                source += f", {_program_steps(ent)} steps"
+            source += ")"
+        elif algo.startswith("synth:") and isinstance(ent.get("program"),
+                                                      dict):
             source = f"synthesized({_program_steps(ent)} steps)"
         elif ent.get("measurements"):
             source = "measured"

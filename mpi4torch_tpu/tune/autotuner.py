@@ -324,14 +324,19 @@ def record(collective: str, dtype, nbytes: int, nranks: int,
            measurements: Optional[dict] = None,
            persist: bool = True, codec=None, tiers=None,
            transition: Optional[str] = None,
-           program: Optional[dict] = None) -> str:
+           program: Optional[dict] = None,
+           ctl: Optional[dict] = None) -> str:
     """Store a winner for a key (and persist).  Bumps the selection
     generation so ``run_spmd`` jit cache keys see the change and
     retrace instead of reusing a lowering picked under the old table.
     ``program`` carries a synthesized winner's serialized IR program
     (mpi4torch_tpu.csched) — required for ``synth:<digest>`` names, so
     a later process can re-install and lower the schedule straight from
-    the cache entry."""
+    the cache entry.  ``ctl`` carries the online-switch provenance the
+    self-tuning controller stamps on winners it installs between steps
+    ({"provenance": "online-switched", "epoch": N, "trigger": ...} —
+    rendered by ``tune --show`` so an operator can tell a measured
+    winner from one a live drift episode installed)."""
     global _generation
     _load()
     key = make_key(collective, dtype, nbytes, nranks, platform,
@@ -339,6 +344,8 @@ def record(collective: str, dtype, nbytes: int, nranks: int,
     ent = {"algorithm": algorithm, "measured_at": time.time()}
     if program is not None:
         ent["program"] = program
+    if ctl is not None:
+        ent["ctl"] = dict(ctl)
     _validate_winner(collective, algorithm, ent)
     name = _codec_name(codec)
     if name is not None:
